@@ -1,0 +1,103 @@
+"""Headline benchmark: GPT tokens/sec/chip, fwd+bwd+optimizer fused step.
+
+Matches BASELINE.json's headline config ("Fleet GPT-3 1.3B tokens/sec/chip");
+on the single available chip we run the largest preset that fits HBM and
+report tokens/sec/chip.  vs_baseline compares against an A100-class
+Megatron GPT-1.3B number (~3500 tokens/s/chip, the north star's "≥A100"
+bar), scaled by parameter count when a smaller preset had to be used.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+A100_GPT13_TOKENS_PER_SEC = 3500.0  # Megatron-class A100 estimate @ 1.3B
+
+
+def run_bench(preset, seq_len, batch, steps=20, warmup=3):
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.text import GPTConfig, GPTForCausalLM, gpt_loss_fn
+
+    pt.seed(0)
+    cfg = GPTConfig.from_preset(
+        preset, vocab_size=50304, max_position_embeddings=seq_len,
+        hidden_dropout=0.0, attention_dropout=0.0, tensor_parallel=False)
+    model = GPTForCausalLM(cfg)
+    # pure bf16 (AMP O2, no fp32 master): Adafactor's factored state keeps
+    # optimizer memory negligible so the 1.3B preset fits one chip's HBM
+    opt = pt.optimizer.Adafactor(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    model, opt = pt.amp.decorate(models=model, optimizers=opt,
+                                 dtype="bfloat16", master_weight=False)
+    step = pt.jit.train_step(model, gpt_loss_fn, opt)
+
+    ids = pt.randint(0, cfg.vocab_size, [batch, seq_len])
+    labels = pt.randint(0, cfg.vocab_size, [batch, seq_len])
+
+    for _ in range(warmup):
+        loss = step(ids, labels)
+    float(loss._array)  # host read: the only reliable sync on the tunnel
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, labels)
+    # the steps chain through donated params, so reading the last loss forces
+    # the whole sequence; block_until_ready alone does not sync on the axon
+    # relay backend
+    final = float(loss._array)
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq_len * steps
+    n_params = sum(p.size for p in model.parameters())
+    return tokens / dt, n_params, final
+
+
+def main():
+    preset_plan = [
+        (os.environ.get("BENCH_PRESET", "gpt3-1.3B"),
+         int(os.environ.get("BENCH_SEQ", "1024")),
+         int(os.environ.get("BENCH_BATCH", "4"))),
+        ("gpt3-760M", 1024, 4),
+        ("gpt3-350M", 1024, 8),
+        ("gpt3-125M", 1024, 8),
+    ]
+    last_err = None
+    for preset, seq, batch in preset_plan:
+        try:
+            tps, n_params, loss = run_bench(preset, seq, batch)
+            params_b = n_params / 1e9
+            # scale the A100 1.3B bar by model size for smaller fallbacks
+            baseline = A100_GPT13_TOKENS_PER_SEC * (1.3e9 / max(n_params, 1))
+            print(json.dumps({
+                "metric": f"GPT({preset}, seq{seq}) train tokens/sec/chip",
+                "value": round(tps, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(tps / baseline, 3),
+            }))
+            print(f"# params={params_b:.2f}B loss={loss:.3f} "
+                  f"batch={batch} seq={seq}", file=sys.stderr)
+            return
+        except Exception as e:  # OOM or compile failure → smaller preset
+            last_err = e
+            print(f"# bench {preset} failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            # drop every live buffer + compiled executable before retrying
+            import gc
+            import jax
+            gc.collect()
+            jax.clear_caches()
+            gc.collect()
+    print(json.dumps({"metric": "GPT train tokens/sec/chip", "value": 0.0,
+                      "unit": "tokens/s/chip", "vs_baseline": 0.0,
+                      "error": str(last_err)[:200]}))
+
+
+if __name__ == "__main__":
+    main()

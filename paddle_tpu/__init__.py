@@ -1,0 +1,61 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the reference
+(anygoanygogo/Paddle) API surface.
+
+Compute path: jax/XLA (+ pallas kernels); eager dygraph via a vjp tape;
+static/"CINN" path via paddle_tpu.jit; distributed via jax.sharding meshes.
+"""
+from . import dtypes as _dtypes_mod
+from .dtypes import (  # noqa: F401
+    float64, float32, float16, bfloat16, int64, int32, int16, int8, uint8,
+    bool_ as bool8, complex64, complex128,
+    set_default_dtype, get_default_dtype, finfo, iinfo,
+)
+from .device import (  # noqa: F401
+    set_device, get_device, is_compiled_with_tpu, device_count,
+    TPUPlace, CPUPlace, Place,
+)
+from .tensor import Tensor, parameter  # noqa: F401
+from .tensor_api import *  # noqa: F401,F403
+from .tensor_api import to_tensor, seed  # noqa: F401
+from .autograd import no_grad, enable_grad, set_grad_enabled, grad  # noqa: F401
+from .autograd import backward as _backward  # noqa: F401
+from . import autograd  # noqa: F401
+from . import amp  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import jit  # noqa: F401
+from . import io  # noqa: F401
+from . import distributed  # noqa: F401
+from . import vision  # noqa: F401
+from . import text  # noqa: F401
+from . import linalg  # noqa: F401
+from . import profiler  # noqa: F401
+from .framework.random import get_rng_state, set_rng_state  # noqa: F401
+
+# paddle-style aliases
+disable_static = lambda *a, **k: None   # always-dynamic by design
+enable_static = lambda *a, **k: None
+
+__version__ = "0.1.0"
+
+
+def is_grad_enabled():
+    from .autograd import engine
+    return engine.grad_enabled()
+
+
+def create_parameter(shape, dtype=None, default_initializer=None,
+                     is_bias=False):
+    import jax.numpy as jnp
+    from .dtypes import convert_dtype, get_default_dtype as _gd
+    t = Tensor(jnp.zeros(tuple(shape), convert_dtype(dtype) or _gd()),
+               stop_gradient=False)
+    if default_initializer is not None:
+        default_initializer(t)
+    return t
+
+
+def summary(layer, input_size=None):
+    n_params = sum(p.size for p in layer.parameters())
+    print(f"{type(layer).__name__}: {n_params:,} parameters")
+    return {"total_params": n_params}

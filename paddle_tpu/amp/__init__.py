@@ -1,0 +1,75 @@
+"""AMP: auto_cast + GradScaler (reference: python/paddle/amp/).
+
+O1: per-op allow/deny list casting at dispatch time (ops/dispatch.py).
+O2: everything in the target dtype except numerically-sensitive denied ops.
+On TPU the target dtype should be bfloat16 (no loss scaling needed); the
+fp16 GradScaler path is kept for API parity and CPU testing.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from .. import dtypes
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+
+_tls = threading.local()
+
+
+class _AmpState:
+    __slots__ = ("dtype", "level")
+
+    def __init__(self, dtype, level):
+        self.dtype = dtype
+        self.level = level
+
+
+def amp_state():
+    return getattr(_tls, "state", None)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """paddle.amp.auto_cast equivalent."""
+    prev = amp_state()
+    if enable:
+        _tls.state = _AmpState(dtypes.convert_dtype(dtype), level)
+    else:
+        _tls.state = None
+    try:
+        yield
+    finally:
+        _tls.state = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None):
+    """Cast model parameters for pure-low-precision training (O2).
+
+    Returns (models, optimizers) like the reference.  Master fp32 weights are
+    kept by the optimizer when master_weight=True (default for O2).
+    """
+    target = dtypes.convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    for m in model_list:
+        if m is None:
+            continue
+        for p in m.parameters():
+            if jnp.issubdtype(p._array.dtype, jnp.floating):
+                p._inplace_assign(p._array.astype(target))
+    if optimizers is None:
+        return models if single else model_list
+    opt_single = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if opt_single else list(optimizers)
+    for o in opt_list:
+        if o is not None and master_weight is not False:
+            o._use_master_weights = True
+    return (models if single else model_list,
+            optimizers if opt_single else opt_list)

@@ -1,0 +1,99 @@
+"""Loss scaling for fp16 AMP (reference: python/paddle/amp/grad_scaler.py).
+
+On TPU the recommended dtype is bfloat16 where scaling is unnecessary
+(enable=False makes every method a passthrough), but the dynamic-scale fp16
+algorithm is implemented fully: scale the loss, unscale grads before step,
+skip the step and shrink the scale when non-finite grads appear.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def _grads_finite(self, optimizer):
+        for p in optimizer._parameters:
+            if p.grad is not None and not bool(
+                    jnp.isfinite(p.grad._array).all()):
+                return False
+        return True
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        self._found_inf = not self._grads_finite(optimizer)
+        inv = 1.0 / self._scale
+        for p in optimizer._parameters:
+            if p.grad is not None:
+                p.grad._array = p.grad._array * inv
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._update_scale()
+
+    def minimize(self, optimizer, loss):
+        self.step(optimizer)
+
+    def update(self):
+        pass  # scale already updated in step()
+
+    def _update_scale(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, s):
+        self._scale = float(s)
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, st):
+        self._scale = st["scale"]
+        self._good_steps = st["good_steps"]
+        self._bad_steps = st["bad_steps"]
+
+
+AmpScaler = GradScaler

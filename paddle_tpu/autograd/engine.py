@@ -1,0 +1,301 @@
+"""Eager autograd engine: a tape of jax.vjp closures.
+
+Reference analog: the dygraph autograd engine
+(paddle/fluid/eager/backward.cc + grad_node_info.h).  The TPU-native design is
+far smaller: every differentiable op executes through ``jax.vjp`` so the
+forward runs exactly once on-device while XLA retains the residuals; backward
+is a reverse-sequence walk calling the stored vjp closures.  Because those
+closures are pure jax functions, second-order grads are obtained by
+re-recording the vjp application on the tape (``create_graph=True``), the
+eager analog of PyTorch/Paddle double-backward graph construction.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_tls = threading.local()
+
+
+def grad_enabled() -> bool:
+    return getattr(_tls, "grad_enabled", True)
+
+
+def set_grad_enabled(mode: bool) -> bool:
+    prev = grad_enabled()
+    _tls.grad_enabled = bool(mode)
+    return prev
+
+
+class no_grad:
+    """Context manager AND decorator disabling tape recording (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with self.__class__():
+                return fn(*a, **k)
+
+        return wrapper
+
+
+class enable_grad(no_grad):
+    def __enter__(self):
+        self._prev = set_grad_enabled(True)
+        return self
+
+
+_seq_counter = [0]
+
+
+class Node:
+    """One recorded differentiable op.
+
+    `closed_fn` takes exactly the differentiable input arrays (non-diff inputs
+    and kwargs are closed over), returning an array or tuple of arrays —
+    re-callable and re-differentiable, which is what powers create_graph.
+    """
+
+    __slots__ = (
+        "name", "closed_fn", "parents", "vjp_fn", "seq",
+        "out_refs", "out_shapes", "out_dtypes", "released", "tuple_out",
+        "__weakref__",
+    )
+
+    def __init__(self, name, closed_fn, parents, vjp_fn, outs,
+                 tuple_out=False):
+        self.name = name
+        self.closed_fn = closed_fn
+        self.parents = parents          # list[Tensor] (diff inputs, strong refs)
+        self.vjp_fn = vjp_fn
+        self.out_refs = [weakref.ref(t) for t in outs]
+        self.out_shapes = [t._array.shape for t in outs]
+        self.out_dtypes = [t._array.dtype for t in outs]
+        self.released = False
+        self.tuple_out = tuple_out
+        _seq_counter[0] += 1
+        self.seq = _seq_counter[0]
+
+    def release(self):
+        self.vjp_fn = None
+        self.closed_fn = None
+        self.parents = ()
+        self.released = True
+
+
+def _is_diff_dtype(dtype) -> bool:
+    return jnp.issubdtype(dtype, jnp.inexact)
+
+
+def apply(name, fn, tensor_args, consts=None):
+    """Execute op `fn(*arrays, **consts)` on Tensor args, recording for backward.
+
+    fn must be a pure jax function returning one array or a tuple of arrays.
+    Integer/bool inputs and stop_gradient tensors are non-differentiable.
+    """
+    from ..tensor import Tensor, _wrap_out  # local import avoids cycle
+
+    arrays = tuple(t._array for t in tensor_args)
+    consts = consts or {}
+
+    diff_idx = [
+        i for i, t in enumerate(tensor_args)
+        if not t.stop_gradient and _is_diff_dtype(t._array.dtype)
+    ]
+    record = grad_enabled() and bool(diff_idx)
+
+    if not record:
+        out = fn(*arrays, **consts)
+        return _wrap_out(out, stop_gradient=True)
+
+    def closed_fn(*diff_arrays):
+        full = list(arrays)
+        for i, a in zip(diff_idx, diff_arrays):
+            full[i] = a
+        return fn(*full, **consts)
+
+    out, vjp_fn = jax.vjp(closed_fn, *[arrays[i] for i in diff_idx])
+    result = _wrap_out(out, stop_gradient=False)
+    outs = result if isinstance(result, tuple) else (result,)
+    tensor_outs = [t for t in outs if isinstance(t, Tensor)]
+    node = Node(name, closed_fn, [tensor_args[i] for i in diff_idx], vjp_fn,
+                tensor_outs, tuple_out=isinstance(out, tuple))
+    for k, t in enumerate(tensor_outs):
+        if _is_diff_dtype(t._array.dtype):
+            t._node = node
+            t._out_index = k
+        else:
+            # integer-valued outputs of a diff op (e.g. argmax aux) carry no grad
+            t.stop_gradient = True
+    return result
+
+
+def _collect_nodes(roots):
+    """All reachable nodes from root tensors, sorted by recording sequence.
+
+    seq order is a valid topological order: a node's parents were always
+    recorded before it.
+    """
+    seen, out, stack = set(), [], []
+    for r in roots:
+        if r._node is not None:
+            stack.append(r._node)
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        out.append(n)
+        if not n.released:
+            for p in n.parents:
+                if p._node is not None and id(p._node) not in seen:
+                    stack.append(p._node)
+    out.sort(key=lambda n: n.seq)
+    return out
+
+
+def run_backward(roots, root_grads, retain_graph=False, create_graph=False,
+                 accumulate_into_grad=True, wanted=None):
+    """Core reverse pass.
+
+    roots: list[Tensor]; root_grads: list of seed cotangents (jnp arrays or
+    Tensors). If `wanted` is given, returns their cotangents (paddle.grad
+    semantics); otherwise accumulates into .grad of leaves (.backward()).
+    In create_graph mode every cotangent is a live Tensor so the backward
+    computation is itself recorded on the tape.
+    """
+    from ..tensor import Tensor
+
+    def as_cot(x):
+        if create_graph:
+            return x if isinstance(x, Tensor) else Tensor._from_array(x)
+        return x._array if isinstance(x, Tensor) else x
+
+    cot = {}    # id(tensor) -> cotangent (array, or Tensor if create_graph)
+    keep = {}   # id -> tensor (keep keys alive)
+    for r, g in zip(roots, root_grads):
+        cot[id(r)] = as_cot(g)
+        keep[id(r)] = r
+
+    order = _collect_nodes(roots)
+    wanted_ids = {id(t) for t in (wanted or [])}
+
+    for node in reversed(order):
+        if node.released:
+            raise RuntimeError(
+                f"backward through '{node.name}': graph already freed; "
+                "call backward(retain_graph=True) to backprop twice")
+        cots, any_live = [], False
+        for ref, shp, dt in zip(node.out_refs, node.out_shapes, node.out_dtypes):
+            t = ref()
+            c = cot.get(id(t)) if t is not None else None
+            if c is None:
+                cots.append(_zero_cot(shp, dt, create_graph))
+            else:
+                any_live = True
+                cots.append(c)
+        if not any_live:
+            continue
+        if create_graph:
+            grads = _vjp_recorded(node, cots)
+        else:
+            payload = tuple(cots) if node.tuple_out else cots[0]
+            grads = node.vjp_fn(payload)
+        for p, g in zip(node.parents, grads):
+            if g is None:
+                continue
+            gdt = g._array.dtype if isinstance(g, Tensor) else g.dtype
+            if gdt == jax.dtypes.float0:
+                continue
+            prev = cot.get(id(p))
+            if prev is None:
+                cot[id(p)] = g
+            elif create_graph:
+                cot[id(p)] = prev + g          # Tensor add → recorded
+            else:
+                cot[id(p)] = jnp.add(prev, g)
+            keep[id(p)] = p
+        if not retain_graph and not create_graph:
+            node.release()
+
+    if accumulate_into_grad:
+        for tid, t in keep.items():
+            if t.stop_gradient:
+                continue
+            if t._node is not None and not t._retain_grads:
+                continue  # non-leaf without retain_grads(): grad not materialized
+            g = cot.get(tid)
+            if g is not None:
+                _accum_grad(t, g)
+
+    if wanted is not None:
+        out = []
+        for t in wanted:
+            g = cot.get(id(t))
+            if g is not None and not isinstance(g, Tensor):
+                g = Tensor._from_array(g, stop_gradient=True)
+            out.append(g)
+        return out
+    return None
+
+
+def _zero_cot(shape, dtype, create_graph):
+    from ..tensor import Tensor
+    if _is_diff_dtype(dtype):
+        z = jnp.zeros(shape, dtype)
+        return Tensor._from_array(z) if create_graph else z
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def _accum_grad(t, total):
+    """Add this pass's cotangent into t.grad (grad accumulation semantics)."""
+    from ..tensor import Tensor
+    arr = total._array if isinstance(total, Tensor) else total
+    if t.grad is not None:
+        arr = t.grad._array + arr
+    t.grad = Tensor._from_array(arr, stop_gradient=True)
+
+
+def _vjp_recorded(node, cots):
+    """Apply node's vjp as a *recorded* op so the backward is differentiable."""
+    from ..tensor import Tensor
+
+    if node.closed_fn is None or any(
+            getattr(c, "dtype", None) == jax.dtypes.float0 for c in cots):
+        # PyLayer / int-output edge: plain (unrecorded) vjp on raw arrays
+        raw = [c._array if isinstance(c, Tensor) else c for c in cots]
+        payload = tuple(raw) if node.tuple_out else raw[0]
+        return node.vjp_fn(payload)
+
+    primal_tensors = list(node.parents)
+    cot_tensors = [
+        c if isinstance(c, Tensor) else Tensor._from_array(c)
+        for c in cots
+    ]
+    n_primal = len(primal_tensors)
+    closed_fn = node.closed_fn
+    tuple_out = node.tuple_out
+
+    def backward_fn(*arrs):
+        primals, cotangents = arrs[:n_primal], arrs[n_primal:]
+        _, vjp_fn = jax.vjp(closed_fn, *primals)
+        payload = tuple(cotangents) if tuple_out else cotangents[0]
+        return vjp_fn(payload)
+
+    result = apply(node.name + "_grad", backward_fn,
+                   primal_tensors + cot_tensors)
+    return tuple(result) if isinstance(result, tuple) else (result,)

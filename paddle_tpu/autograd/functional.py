@@ -1,0 +1,64 @@
+"""paddle.grad / backward equivalents (python/paddle/autograd/backward_mode.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import engine
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _default_seed(t):
+    if t._array.size != 1:
+        raise RuntimeError(
+            "grad can be implicitly created only for scalar outputs; "
+            f"got shape {t._array.shape}. Pass grad_outputs explicitly.")
+    return jnp.ones(t._array.shape, t._array.dtype)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward — accumulate into .grad of leaves."""
+    roots = _as_list(tensors)
+    seeds = _as_list(grad_tensors)
+    if not seeds:
+        seeds = [_default_seed(t) for t in roots]
+    else:
+        seeds = [s if s is not None else _default_seed(r)
+                 for r, s in zip(roots, seeds)]
+    engine.run_backward(roots, seeds, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad — return grads of `outputs` wrt `inputs` without touching .grad."""
+    roots = _as_list(outputs)
+    wanted = _as_list(inputs)
+    seeds = _as_list(grad_outputs)
+    if not seeds:
+        seeds = [_default_seed(t) for t in roots]
+    else:
+        # None inside grad_outputs means an implicit ones seed (reference
+        # semantics), not "no cotangent"
+        seeds = [s if s is not None else _default_seed(r)
+                 for r, s in zip(roots, seeds)]
+    if retain_graph is None:
+        retain_graph = create_graph
+    grads = engine.run_backward(
+        roots, seeds, retain_graph=retain_graph, create_graph=create_graph,
+        accumulate_into_grad=False, wanted=wanted)
+    out = []
+    for t, g in zip(wanted, grads):
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the inputs was not used in the graph; "
+                    "set allow_unused=True to return None for it")
+            out.append(None)
+        else:
+            out.append(g)
+    return out

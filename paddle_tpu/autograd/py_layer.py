@@ -1,0 +1,88 @@
+"""PyLayer: user-defined forward/backward (python/paddle/autograd/py_layer.py).
+
+TPU-native: forward runs eagerly on raw jax arrays; the user's backward is
+installed as the node's vjp closure so it slots into the same tape walk as
+every built-in op.
+"""
+from __future__ import annotations
+
+from . import engine
+from .engine import Node
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.saved_extras = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, ns):
+        super().__init__(name, bases, ns)
+        if bases:
+            for m in ("forward", "backward"):
+                if m not in ns and not any(hasattr(b, m) for b in bases[1:]):
+                    pass  # allow inheriting
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..tensor import Tensor, _wrap_out
+
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        with engine.no_grad():
+            result = cls.forward(ctx, *args, **kwargs)
+        result = _wrap_out(
+            result._array if isinstance(result, Tensor) else
+            tuple(r._array for r in result) if isinstance(result, tuple) else result,
+            stop_gradient=True)
+        outs = result if isinstance(result, tuple) else (result,)
+
+        diff_parents = [
+            t for t in tensor_args
+            if not t.stop_gradient and engine._is_diff_dtype(t._array.dtype)
+        ]
+        if not engine.grad_enabled() or not diff_parents:
+            return result
+
+        def vjp_fn(payload):
+            from ..tensor import Tensor as T
+            cots = payload if isinstance(payload, tuple) else (payload,)
+            cot_tensors = tuple(T._from_array(c) for c in cots)
+            with engine.no_grad():
+                grads = cls.backward(ctx, *cot_tensors)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            # user's backward returns one grad per tensor input (paddle
+            # semantics); select the entries for differentiable parents
+            per_input = {id(t): g for t, g in zip(tensor_args, grads)}
+            out = []
+            for t in diff_parents:
+                g = per_input.get(id(t))
+                out.append(None if g is None else
+                           (g._array if isinstance(g, T) else g))
+            return tuple(out)
+
+        node = Node(cls.__name__, None, diff_parents, vjp_fn, list(outs),
+                    tuple_out=isinstance(result, tuple))
+        for k, t in enumerate(outs):
+            t.stop_gradient = False
+            t._node = node
+            t._out_index = k
+        return result

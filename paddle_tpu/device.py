@@ -1,0 +1,89 @@
+"""Place / device abstraction.
+
+Reference surface: paddle.device.set_device / CUDAPlace / CPUPlace / XPUPlace
+(python/paddle/device/__init__.py).  TPU-native: a Place names a jax device;
+``tpu`` is the first-class accelerator.  There are no streams to manage —
+XLA's async dispatch replaces the reference's stream/event machinery.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Place:
+    device_type = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if _kind(d) == self.device_type]
+        if not devs:  # fall back to cpu backend
+            devs = jax.devices("cpu")
+        return devs[min(self.device_id, len(devs) - 1)]
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+
+def _kind(jax_dev) -> str:
+    p = jax_dev.platform
+    return "tpu" if p in ("tpu", "axon") else p
+
+
+_current_place = [None]
+
+
+def _default_place() -> Place:
+    kinds = {_kind(d) for d in jax.devices()}
+    return TPUPlace(0) if "tpu" in kinds else CPUPlace(0)
+
+
+def set_device(device: str):
+    """set_device("tpu") / set_device("tpu:0") / set_device("cpu")."""
+    name, _, idx = device.partition(":")
+    idx = int(idx) if idx else 0
+    if name in ("tpu", "gpu", "xpu", "npu"):  # accelerator aliases all map to tpu
+        _current_place[0] = TPUPlace(idx)
+    elif name == "cpu":
+        _current_place[0] = CPUPlace(idx)
+    else:
+        raise ValueError(f"unknown device {device!r}")
+    return _current_place[0]
+
+
+def get_device() -> str:
+    p = current_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def current_place() -> Place:
+    if _current_place[0] is None:
+        _current_place[0] = _default_place()
+    return _current_place[0]
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(_kind(d) == "tpu" for d in jax.devices())
+
+
+def device_count() -> int:
+    return len(jax.devices())

@@ -1,0 +1,120 @@
+"""Collective communication API (reference: python/paddle/distributed/
+communication/*, backed there by ProcessGroupNCCL).
+
+TPU-native double life:
+  * inside shard_map-traced code, these lower to XLA collectives
+    (psum/all_gather/ppermute) riding ICI;
+  * eagerly in a single-controller process they are identity ops (world=1
+    per process — jax is single-controller, data lives globally sharded).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor import Tensor
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _in_shard_map(axis_name):
+    try:
+        lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+
+
+def _axis(group):
+    if group is None:
+        return "dp"
+    return getattr(group, "axis_name", group if isinstance(group, str) else "dp")
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = _axis(group)
+    if isinstance(tensor, Tensor):
+        try:
+            fn = {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax,
+                  ReduceOp.MIN: lax.pmin,
+                  ReduceOp.AVG: lax.pmean}[op]
+            tensor._array = fn(tensor._array, axis)
+        except NameError:
+            pass  # eager single-process: identity
+        return tensor
+    fn = {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax,
+          ReduceOp.MIN: lax.pmin, ReduceOp.AVG: lax.pmean}[op]
+    return fn(tensor, axis)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    axis = _axis(group)
+    arr = tensor._array if isinstance(tensor, Tensor) else tensor
+    try:
+        gathered = lax.all_gather(arr, axis)
+        if tensor_list is not None:
+            tensor_list.extend(
+                Tensor._from_array(gathered[i])
+                for i in range(gathered.shape[0]))
+            return tensor_list
+        return gathered
+    except NameError:
+        if tensor_list is not None:
+            tensor_list.append(tensor)
+            return tensor_list
+        return arr[None]
+
+
+def reduce_scatter(output, input_list_or_tensor, op=ReduceOp.SUM, group=None):
+    axis = _axis(group)
+    arr = input_list_or_tensor._array if isinstance(
+        input_list_or_tensor, Tensor) else input_list_or_tensor
+    try:
+        out = lax.psum_scatter(arr, axis, tiled=True)
+    except NameError:
+        out = arr
+    if isinstance(output, Tensor):
+        output._array = out
+        return output
+    return out
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    # single-controller: all replicas already share the value
+    return tensor
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None):
+    if tensor_list:
+        tensor._array = tensor_list[0]._array
+    return tensor
+
+
+def send(tensor, dst=0, group=None):
+    raise NotImplementedError(
+        "point-to-point send/recv maps to lax.ppermute inside shard_map; "
+        "use paddle_tpu.distributed.ppermute")
+
+
+recv = send
+
+
+def ppermute(x, axis_name, perm):
+    arr = x._array if isinstance(x, Tensor) else x
+    out = lax.ppermute(arr, axis_name, perm)
+    return Tensor._from_array(out) if isinstance(x, Tensor) else out
+
+
+def barrier(group=None):
+    jax.block_until_ready(jnp.zeros(()))
+
+
+def stream_synchronize():
+    barrier()

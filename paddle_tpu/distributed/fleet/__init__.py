@@ -1,0 +1,135 @@
+"""Fleet API (reference: python/paddle/distributed/fleet/__init__.py).
+
+fleet.init(strategy) builds the global jax mesh from hybrid_configs;
+fleet.distributed_model / distributed_optimizer keep the reference calling
+convention; the heavy lifting happens in DistributedTrainStep
+(distributed/fleet_engine.py) where the whole hybrid strategy becomes one
+pjit'd XLA program.
+"""
+from __future__ import annotations
+
+from .. import mesh as mesh_mod
+from ..fleet_engine import DistributedTrainStep
+from ..recompute import recompute  # noqa: F401  (fleet.utils.recompute parity)
+from ... import optimizer as _opt_mod
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sharding_stage": 0,
+            "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.pipeline_configs = {"accumulate_steps": 1}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+
+
+class _Fleet:
+    def __init__(self):
+        self._strategy = None
+        self._initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        mesh_mod.build_mesh(dp=int(hc.get("dp_degree", 1) or 1),
+                            pp=int(hc.get("pp_degree", 1) or 1),
+                            mp=int(hc.get("mp_degree", 1) or 1))
+        self._initialized = True
+        return self
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+    def distributed_model(self, model):
+        model._fleet_strategy = self._strategy
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        optimizer._fleet_strategy = strategy or self._strategy
+        return optimizer
+
+    def build_train_step(self, model, loss_fn, optimizer):
+        return DistributedTrainStep(model, loss_fn, optimizer,
+                                    strategy=self._strategy)
+
+    # topology queries (HybridCommunicateGroup surface)
+    def worker_num(self):
+        import jax
+        return jax.process_count()
+
+    def worker_index(self):
+        import jax
+        return jax.process_index()
+
+    def get_hybrid_communicate_group(self):
+        return HybridCommunicateGroup(self._strategy)
+
+
+class HybridCommunicateGroup:
+    """Axis-size/rank queries (reference: fleet/base/topology.py)."""
+
+    def __init__(self, strategy):
+        self._s = strategy
+
+    def get_data_parallel_world_size(self):
+        return mesh_mod.degree("dp")
+
+    def get_model_parallel_world_size(self):
+        return mesh_mod.degree("mp")
+
+    def get_pipe_parallel_world_size(self):
+        return mesh_mod.degree("pp")
+
+    def get_data_parallel_rank(self):
+        return 0  # single-controller: ranks are internal to XLA
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_group(self):
+        return _AxisGroup("mp")
+
+    def get_data_parallel_group(self):
+        return _AxisGroup("dp")
+
+    def get_pipe_parallel_group(self):
+        return _AxisGroup("pp")
+
+
+class _AxisGroup:
+    def __init__(self, axis_name):
+        self.axis_name = axis_name
+
+    @property
+    def nranks(self):
+        return mesh_mod.degree(self.axis_name)
+
+
+fleet = _Fleet()
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+build_train_step = fleet.build_train_step
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+
+
+class utils:
+    recompute = staticmethod(recompute)
+
+
+# meta_parallel namespace (reference import path parity)
+from .. import parallel_layers as meta_parallel  # noqa: E402,F401
+from ..parallel_layers import (  # noqa: E402,F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy,
+)

@@ -1,0 +1,60 @@
+"""Global device-mesh management.
+
+Reference analog: Fleet's HybridCommunicateGroup topology
+(python/paddle/distributed/fleet/base/topology.py), which carves NCCL
+communicators per axis.  TPU-native: ONE jax.sharding.Mesh with named axes
+("dp", "pp", "mp") — XLA routes collectives over ICI per axis; sharding
+(ZeRO) rides the "dp" axis; sequence parallel rides "mp".
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_state = {"mesh": None, "degrees": None}
+
+AXES = ("dp", "pp", "mp")
+
+
+def build_mesh(dp=1, pp=1, mp=1, devices=None):
+    devices = devices if devices is not None else jax.devices()
+    n = dp * pp * mp
+    if n > len(devices):
+        raise ValueError(
+            f"hybrid degrees dp{dp}*pp{pp}*mp{mp}={n} > {len(devices)} devices")
+    devs = np.asarray(devices[:n]).reshape(dp, pp, mp)
+    mesh = Mesh(devs, AXES)
+    _state["mesh"] = mesh
+    _state["degrees"] = {"dp": dp, "pp": pp, "mp": mp}
+    return mesh
+
+
+def get_mesh() -> Mesh:
+    if _state["mesh"] is None:
+        build_mesh(dp=len(jax.devices()))
+    return _state["mesh"]
+
+
+def set_mesh(mesh):
+    _state["mesh"] = mesh
+    _state["degrees"] = {a: mesh.shape[a] for a in mesh.axis_names}
+
+
+def degree(axis) -> int:
+    if _state["degrees"] is None:
+        return 1
+    return _state["degrees"].get(axis, 1)
+
+
+def has_mesh() -> bool:
+    return _state["mesh"] is not None
+
+
+def sharding(*spec):
+    """NamedSharding on the global mesh for a PartitionSpec."""
+    return NamedSharding(get_mesh(), P(*spec))
+
+
+def replicated():
+    return NamedSharding(get_mesh(), P())

@@ -1,0 +1,115 @@
+"""Tensor-parallel layers (reference: python/paddle/distributed/fleet/
+meta_parallel/parallel_layers/mp_layers.py — ColumnParallelLinear etc.).
+
+TPU-native: instead of manual identity/allreduce ops around matmuls, each
+layer ANNOTATES its parameters with a PartitionSpec over the "mp" mesh axis;
+the fleet engine feeds those specs to pjit and XLA/GSPMD inserts the
+all-reduce / all-gather collectives on ICI automatically — same math, but the
+compiler overlaps them with compute.
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from . import mesh as mesh_mod
+
+
+class ColumnParallelLinear(Layer):
+    """W [in, out] split along out ("mp"); output stays sharded unless
+    gather_output."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features],
+            default_initializer=I.XavierUniform())
+        self.weight.pspec = P(None, "mp")
+        if has_bias:
+            self.bias = self.create_parameter(
+                [out_features], is_bias=True,
+                default_initializer=I.Constant(0.0))
+            self.bias.pspec = P("mp")
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if not self.gather_output:
+            out = shard_activation(out, P(*([None] * (out.ndim - 1) + ["mp"])))
+        return out
+
+
+class RowParallelLinear(Layer):
+    """W [in, out] split along in ("mp"); XLA inserts the psum."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features],
+            default_initializer=I.XavierUniform())
+        self.weight.pspec = P("mp", None)
+        if has_bias:
+            self.bias = self.create_parameter(
+                [out_features], is_bias=True,
+                default_initializer=I.Constant(0.0))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding table split along vocab ("mp")."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim],
+            default_initializer=I.Normal(0.0, 0.02))
+        self.weight.pspec = P("mp", None)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross entropy over a vocab-sharded logits tensor; under GSPMD the
+    softmax reductions become mp-axis collectives automatically."""
+
+    def __init__(self, mp_group=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, label):
+        return F.cross_entropy(logits, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+def shard_activation(x, spec):
+    """with_sharding_constraint on a Tensor (sequence-parallelism hook),
+    recorded as a differentiable op. No-op when no mesh is active."""
+    from ..tensor import Tensor
+    from ..autograd import engine
+    import jax
+    if not mesh_mod.has_mesh():
+        return x
+    sh = mesh_mod.sharding(*spec)
+    if isinstance(x, Tensor):
+        try:
+            return engine.apply(
+                "shard_constraint",
+                lambda a: jax.lax.with_sharding_constraint(a, sh), [x])
+        except Exception:
+            return x
+    return jax.lax.with_sharding_constraint(x, sh)

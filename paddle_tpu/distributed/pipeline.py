@@ -1,0 +1,130 @@
+"""Pipeline parallelism (reference: python/paddle/distributed/fleet/
+meta_parallel/pipeline_parallel.py + pp_layers — PipelineLayer, 1F1B/GPipe
+interleaving over NCCL send/recv).
+
+TPU-native: the pipeline is ONE shard_map over the "pp" mesh axis.  Stage
+parameters are stacked on a leading pp axis; each device scans its own
+layers; activations travel stage→stage via lax.ppermute inside a lax.scan
+over the GPipe schedule (M microbatches + P-1 bubble steps).  Because the
+whole schedule is a differentiable scan, jax.grad derives the backward
+pipeline automatically — no hand-written 1F1B bookkeeping, and XLA overlaps
+ppermute with compute on ICI.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def gpipe_spmd(stage_fn, n_stages, n_microbatches, axis_name="pp"):
+    """Build the per-device pipelined function.
+
+    stage_fn(stage_params, x_mb) -> y_mb : runs ONE stage's layers on one
+    microbatch.  Returns fn(stacked_stage_params, x_microbatched) usable
+    under shard_map, where stacked params have leading axis n_stages (sharded
+    over "pp") and x is [M, mb, ...] (replicated or dp-sharded).
+    """
+
+    def pipelined(stage_params, x_mb):
+        # under shard_map: stage_params leading axis == 1 (this stage) — squeeze
+        my_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+        idx = lax.axis_index(axis_name)
+        P_ = n_stages
+        M = n_microbatches
+        T = M + P_ - 1
+        mb_shape = x_mb.shape[1:]
+
+        out_buf = jnp.zeros((M,) + mb_shape, x_mb.dtype)
+        state = jnp.zeros(mb_shape, x_mb.dtype)
+
+        def body(carry, t):
+            state, out_buf = carry
+            # stage 0 ingests microbatch t (clipped; masked later)
+            inject = x_mb[jnp.clip(t, 0, M - 1)]
+            cur = jnp.where(idx == 0, inject, state)
+            y = stage_fn(my_params, cur)
+            # last stage emits microbatch t-(P-1)
+            emit_t = jnp.clip(t - (P_ - 1), 0, M - 1)
+            is_emit = (t >= P_ - 1) & (idx == P_ - 1)
+            prev = lax.dynamic_index_in_dim(out_buf, emit_t, 0,
+                                            keepdims=False)
+            upd = jnp.where(is_emit, y, prev)
+            out_buf = lax.dynamic_update_index_in_dim(out_buf, upd, emit_t, 0)
+            # rotate activations to the next stage
+            perm = [(i, (i + 1) % P_) for i in range(P_)]
+            state = lax.ppermute(y, axis_name, perm)
+            return (state, out_buf), None
+
+        (state, out_buf), _ = lax.scan(body, (state, out_buf),
+                                       jnp.arange(T))
+        # out_buf only valid on the last stage; broadcast via masked psum
+        out = lax.psum(
+            jnp.where(idx == P_ - 1, out_buf,
+                      jnp.zeros_like(out_buf)), axis_name)
+        return out[None]  # restore the leading pp axis for shard_map out_spec
+
+    return pipelined
+
+
+def pipeline_apply(stage_fn, stacked_params, x_microbatched, mesh,
+                   n_stages, n_microbatches, axis_name="pp",
+                   param_specs=None):
+    """Run the GPipe schedule over `mesh` axis `axis_name` (arrays API)."""
+    fn = gpipe_spmd(stage_fn, n_stages, n_microbatches, axis_name)
+    if param_specs is None:
+        param_specs = jax.tree_util.tree_map(
+            lambda _: P(axis_name), stacked_params)
+    in_specs = (param_specs, P())     # params sharded by stage; data replicated
+    out_specs = P(axis_name)
+    mapped = jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+    out = mapped(stacked_params, x_microbatched)
+    # out: [n_stages, M, ...] with every stage holding the same emitted
+    # values after the final broadcast — take stage 0's copy
+    return out[0]
+
+
+class PipelineLayer:
+    """Stage-partition descriptor (reference: PipelineLayer in pp_layers.py).
+
+    Collects N homogeneous blocks; `stack_params()` stacks their parameters on
+    a leading axis for the SPMD pipeline. Embedding/head stay outside the
+    pipelined region (computed under plain GSPMD), the standard TPU design.
+    """
+
+    def __init__(self, blocks, n_stages):
+        assert len(blocks) % n_stages == 0, \
+            "#blocks must divide evenly into pipeline stages"
+        self.blocks = blocks
+        self.n_stages = n_stages
+        self.layers_per_stage = len(blocks) // n_stages
+
+    def stacked_param_arrays(self):
+        """[n_stages, layers_per_stage, ...] per parameter leaf."""
+        names = [n for n, _ in self.blocks[0].named_parameters()]
+        stacked = {}
+        for name in names:
+            per_block = [dict(b.named_parameters())[name]._array
+                         for b in self.blocks]
+            leaf = jnp.stack(per_block).reshape(
+                (self.n_stages, self.layers_per_stage)
+                + per_block[0].shape)
+            stacked[name] = leaf
+        return stacked
+
+    def make_stage_fn(self, block_apply):
+        """block_apply(param_dict, x) -> y for ONE block; returns
+        stage_fn(stage_params, x) scanning layers_per_stage blocks."""
+
+        def stage_fn(stage_params, x):
+            def scan_block(h, layer_params):
+                return block_apply(layer_params, h), None
+
+            y, _ = lax.scan(scan_block, x, stage_params)
+            return y
+
+        return stage_fn

@@ -1,0 +1,75 @@
+"""Activation recomputation (reference: python/paddle/distributed/fleet/
+recompute/recompute.py).
+
+TPU-native: jax.checkpoint (remat) around a pure re-execution of the wrapped
+callable — the tape stores only the inputs; backward re-runs the forward
+under XLA, trading FLOPs for HBM exactly like the reference's
+RecomputeFunction, but compiler-scheduled.  When `function` is a Layer (the
+common fleet usage: recompute(block, x)), its parameters are lifted to
+differentiable inputs via the functional bridge so their grads still flow.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ..autograd import engine
+from ..tensor import Tensor
+
+
+def _policy(name):
+    if name is None or name == "full":
+        return None
+    return getattr(jax.checkpoint_policies, name)
+
+
+def recompute(function, *args, **kwargs):
+    """recompute(layer_or_fn, *args) — forward without storing intermediates."""
+    from ..nn.layer import Layer
+    from ..framework import random as _random
+    from ..jit import functional_bridge as FB
+
+    preserve = kwargs.pop("preserve_rng_state", True)
+    policy = _policy(kwargs.pop("policy", None))
+    use_reentrant = kwargs.pop("use_reentrant", True)  # noqa: F841 (parity)
+
+    statics = [None if isinstance(a, Tensor) else a for a in args]
+    tensors = [a for a in args if isinstance(a, Tensor)]
+
+    layer = function if isinstance(function, Layer) else None
+    if layer is not None:
+        pn, pa, bn, ba = FB.split_state(layer)
+        param_tensors = list(dict(layer.named_parameters()).values())
+    else:
+        pn = bn = ()
+        pa = ba = ()
+        param_tensors = []
+
+    rng = _random.next_key() if preserve else None
+    n_params = len(param_tensors)
+    n_buf = len(bn)
+
+    def pure(*arrays):
+        it = iter(arrays)
+        p_arrays = [next(it) for _ in range(n_params)]
+        b_arrays = [next(it) for _ in range(n_buf)]
+        call_args = [Tensor._from_array(next(it)) if s is None else s
+                     for s in statics]
+        ctx = _random.key_context(next(it)) if preserve else \
+            contextlib.nullcontext()
+        if layer is not None:
+            with FB._swapped(layer, pn, p_arrays, bn, b_arrays):
+                with ctx, engine.no_grad():
+                    out = function(*call_args, **kwargs)
+        else:
+            with ctx, engine.no_grad():
+                out = function(*call_args, **kwargs)
+        return FB._unwrap(out)
+
+    ck = jax.checkpoint(pure, policy=policy)
+    inputs = (param_tensors
+              + [Tensor._from_array(a) for a in ba]
+              + tensors
+              + ([Tensor._from_array(rng)] if preserve else []))
+    return engine.apply("recompute", ck, inputs)

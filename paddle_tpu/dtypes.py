@@ -1,0 +1,91 @@
+"""Dtype system.
+
+Mirrors the reference framework's dtype surface (python/paddle/framework/dtype.py):
+float64/32/16, bfloat16, int8..64, uint8, bool, complex64/128, exposed both as
+module-level singletons (``paddle_tpu.float32``) and accepted as strings.
+Internally every dtype is a ``jnp.dtype`` so tensors flow straight into XLA.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (numpy dtype instances, which jax accepts natively).
+float64 = jnp.dtype("float64")
+float32 = jnp.dtype("float32")
+float16 = jnp.dtype("float16")
+bfloat16 = jnp.dtype(jnp.bfloat16)
+int64 = jnp.dtype("int64")
+int32 = jnp.dtype("int32")
+int16 = jnp.dtype("int16")
+int8 = jnp.dtype("int8")
+uint8 = jnp.dtype("uint8")
+bool_ = jnp.dtype("bool")
+complex64 = jnp.dtype("complex64")
+complex128 = jnp.dtype("complex128")
+
+_STR_ALIASES = {
+    "float64": float64, "double": float64,
+    "float32": float32, "float": float32,
+    "float16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "int64": int64, "long": int64,
+    "int32": int32, "int": int32,
+    "int16": int16, "short": int16,
+    "int8": int8, "uint8": uint8,
+    "bool": bool_,
+    "complex64": complex64, "complex128": complex128,
+}
+
+_DEFAULT_DTYPE = [float32]
+
+
+def convert_dtype(dtype):
+    """Normalize any user-supplied dtype (str / np / jnp / paddle-style) to jnp.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower().replace("paddle.", "")
+        if key in _STR_ALIASES:
+            return _STR_ALIASES[key]
+        return jnp.dtype(key)
+    return jnp.dtype(dtype)
+
+
+def set_default_dtype(dtype):
+    d = convert_dtype(dtype)
+    if d not in (float64, float32, float16, bfloat16):
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _DEFAULT_DTYPE[0] = d
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def is_floating_point_dtype(dtype):
+    return jnp.issubdtype(convert_dtype(dtype), jnp.floating)
+
+
+def is_integer_dtype(dtype):
+    d = convert_dtype(dtype)
+    return jnp.issubdtype(d, jnp.integer) or d == bool_
+
+
+def finfo(dtype):
+    return jnp.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return jnp.iinfo(convert_dtype(dtype))
+
+
+def promote_types(a, b):
+    return jnp.promote_types(convert_dtype(a), convert_dtype(b))
+
+
+def dtype_name(dtype) -> str:
+    d = convert_dtype(dtype)
+    if d == bfloat16:
+        return "bfloat16"
+    return np.dtype(d).name

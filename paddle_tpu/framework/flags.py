@@ -1,0 +1,19 @@
+"""Global config flags (reference: paddle/phi/core/flags + FLAGS_* env vars)."""
+from __future__ import annotations
+
+import os
+
+_FLAGS = {
+    # inject finite-checks on losses/grads (failure detection subsystem)
+    "check_numerics": os.environ.get("PT_CHECK_NUMERICS", "0") == "1",
+    # default matmul precision on TPU ("default" | "high" | "highest")
+    "matmul_precision": os.environ.get("PT_MATMUL_PRECISION", "default"),
+}
+
+
+def set_flags(d: dict):
+    _FLAGS.update(d)
+
+
+def get_flags(name: str):
+    return _FLAGS.get(name)

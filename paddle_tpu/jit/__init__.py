@@ -1,0 +1,195 @@
+"""paddle_tpu.jit — the dy2static + CINN equivalent.
+
+`to_static(layer)` compiles the layer's forward into ONE cached XLA program
+(jax.jit).  Backward still works: the compiled forward is recorded on the
+autograd tape as a single op whose vjp re-traces through the same program, so
+eager training code (`loss.backward()`; `opt.step()`) gets compiled execution
+transparently.  Reference: python/paddle/jit/api.py::to_static.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import engine
+from ..framework import random as _random
+from ..tensor import Tensor
+from ..nn.layer import Layer
+from . import functional_bridge as FB
+from .train_step import train_step, TrainStep  # noqa: F401
+
+
+class StaticFunction:
+    def __init__(self, layer, fn=None):
+        self._layer = layer
+        self._fn = fn  # unbound forward substitute, if not layer.__call__
+        self._pure_cache = {}   # (training, static_key) -> jitted pure fn
+        self._out_treedef = {}
+
+    @property
+    def layer(self):
+        return self._layer
+
+    def _build_pure(self, training, static_kwargs, in_treedef, n_args):
+        layer = self._layer
+        key = (training, tuple(sorted(static_kwargs.items())), in_treedef,
+               n_args)
+        if key in self._pure_cache:
+            return self._pure_cache[key], key
+
+        def pure(*arrays):
+            pn, _, bn, _ = FB.split_state(layer)
+            n_p, n_b = len(pn), len(bn)
+            p_arrays = arrays[:n_p]
+            b_arrays = arrays[n_p:n_p + n_b]
+            rng = arrays[n_p + n_b]
+            in_arrays = arrays[n_p + n_b + 1:]
+            args = jax.tree_util.tree_unflatten(
+                in_treedef, [Tensor._from_array(a) for a in in_arrays])
+            prev = layer.training
+            _set_training(layer, training)
+            try:
+                out, new_buffers = FB.call_functional(
+                    layer, p_arrays, b_arrays, args,
+                    kwargs_arrays=static_kwargs, rng_key=rng, fn=self._fn)
+            finally:
+                _set_training(layer, prev)
+            flat_out, out_treedef = jax.tree_util.tree_flatten(out)
+            self._out_treedef[key] = (out_treedef, len(flat_out))
+            return tuple(flat_out) + tuple(new_buffers)
+
+        jitted = jax.jit(pure)
+        self._pure_cache[key] = jitted
+        return jitted, key
+
+    def __call__(self, *args, **kwargs):
+        layer = self._layer
+        params = list(dict(layer.named_parameters()).values())
+        buffer_d = dict(layer.named_buffers())
+        buffers = list(buffer_d.values())
+        static_kwargs = {k: v for k, v in kwargs.items()
+                         if not isinstance(v, Tensor)}
+        tensor_kwargs = {k: v for k, v in kwargs.items()
+                         if isinstance(v, Tensor)}
+        if tensor_kwargs:
+            # fold tensor kwargs into the positional pytree
+            args = args + (tensor_kwargs,)
+        flat_in, in_treedef = jax.tree_util.tree_flatten(
+            args, is_leaf=lambda x: isinstance(x, Tensor))
+        in_tensors = [a if isinstance(a, Tensor) else Tensor._from_array(
+            jnp.asarray(a)) for a in flat_in]
+        rng = Tensor._from_array(_random.next_key())
+
+        pure, key = self._build_pure(layer.training, static_kwargs,
+                                     in_treedef, len(in_tensors))
+        all_inputs = params + buffers + [rng] + in_tensors
+        result = engine.apply("to_static", pure, all_inputs)
+        result = result if isinstance(result, tuple) else (result,)
+        out_treedef, n_out = self._out_treedef[key]
+        outs = [t for t in result[:n_out]]
+        new_buffer_ts = result[n_out:]
+        for b, nb in zip(buffers, new_buffer_ts):
+            if b._array is not nb._array:
+                b._inplace_assign(nb._array)
+        out_arrays_or_tensors = outs
+        return jax.tree_util.tree_unflatten(out_treedef,
+                                            out_arrays_or_tensors)
+
+
+def _set_training(layer, mode):
+    layer.training = mode
+    for l in layer.sublayers():
+        l.training = mode
+
+
+def to_static(function=None, input_spec=None, full_graph=True, **kwargs):
+    """Decorator/wrapper compiling a Layer or function to one XLA program."""
+    def wrap(target):
+        if isinstance(target, Layer):
+            return StaticFunction(target)
+        if callable(target):
+            # bare function of Tensors: jit directly through the tape
+            return _static_fn(target)
+        raise TypeError(type(target))
+    if function is not None:
+        return wrap(function)
+    return wrap
+
+
+def _static_fn(fn):
+    cache = {}
+
+    @functools.wraps(fn)
+    def wrapper(*args):
+        flat_in, in_treedef = jax.tree_util.tree_flatten(
+            args, is_leaf=lambda x: isinstance(x, Tensor))
+        in_tensors = [a if isinstance(a, Tensor) else
+                      Tensor._from_array(jnp.asarray(a)) for a in flat_in]
+        state = cache.get(in_treedef)
+        if state is None:
+            out_info = {}
+
+            def pure(*arrays):
+                targs = jax.tree_util.tree_unflatten(
+                    in_treedef,
+                    [Tensor._from_array(a) for a in arrays])
+                with engine.no_grad():
+                    out = fn(*targs)
+                flat_out, td = jax.tree_util.tree_flatten(FB._unwrap(out))
+                out_info["td"] = td
+                out_info["n"] = len(flat_out)
+                return tuple(flat_out)
+
+            state = (jax.jit(pure), out_info)
+            cache[in_treedef] = state
+        pure, out_info = state
+        result = engine.apply("to_static_fn", pure, in_tensors)
+        result = result if isinstance(result, tuple) else (result,)
+        return jax.tree_util.tree_unflatten(out_info["td"], list(result))
+
+    return wrapper
+
+
+def not_to_static(fn):
+    return fn
+
+
+# ------------------------------------------------------------- save / load
+def save(obj, path, **kwargs):
+    """paddle.save: state_dicts / Tensors / nested python objects."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    import numpy as np
+
+    def conv(o):
+        if isinstance(o, Tensor):
+            return {"__tensor__": True, "data": np.asarray(o._array),
+                    "stop_gradient": o.stop_gradient}
+        if isinstance(o, dict):
+            return {k: conv(v) for k, v in o.items()}
+        if isinstance(o, (list, tuple)):
+            return type(o)(conv(v) for v in o)
+        return o
+
+    with open(path, "wb") as f:
+        pickle.dump(conv(obj), f)
+
+
+def load(path, **kwargs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+
+    def conv(o):
+        if isinstance(o, dict):
+            if o.get("__tensor__"):
+                return Tensor(o["data"],
+                              stop_gradient=o.get("stop_gradient", True))
+            return {k: conv(v) for k, v in o.items()}
+        if isinstance(o, (list, tuple)):
+            return type(o)(conv(v) for v in o)
+        return o
+
+    return conv(obj)

@@ -1,0 +1,105 @@
+"""Functional bridge: run a stateful Layer as a pure function of arrays.
+
+This is the dy2static core (reference: python/paddle/jit/dy2static/*): instead
+of AST-transcribing Python to a Program IR, we temporarily swap every
+parameter/buffer's storage for traced arrays, run the eager forward with tape
+recording off, and let jax trace the whole thing into one XLA computation —
+XLA then plays the role of CINN (fusion, scheduling, tiling).
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..autograd import engine
+from ..framework import random as _random
+from ..tensor import Tensor
+
+
+def split_state(layer):
+    """Return (param_names, param_arrays, buffer_names, buffer_arrays)."""
+    pn, pa = [], []
+    for n, p in layer.named_parameters():
+        pn.append(n)
+        pa.append(p._array)
+    bn, ba = [], []
+    for n, b in layer.named_buffers():
+        bn.append(n)
+        ba.append(b._array)
+    return pn, pa, bn, ba
+
+
+def _state_tensors(layer):
+    params = dict(layer.named_parameters())
+    buffers = dict(layer.named_buffers())
+    return params, buffers
+
+
+@contextlib.contextmanager
+def _swapped(layer, param_names, param_arrays, buffer_names, buffer_arrays):
+    params, buffers = _state_tensors(layer)
+    saved = {}
+    for n, a in zip(param_names, param_arrays):
+        saved[n] = params[n]._array
+        params[n]._array = a
+    for n, a in zip(buffer_names, buffer_arrays):
+        saved["B:" + n] = buffers[n]._array
+        buffers[n]._array = a
+    try:
+        yield params, buffers
+    finally:
+        for n in param_names:
+            params[n]._array = saved[n]
+        for n in buffer_names:
+            buffers[n]._array = saved["B:" + n]
+
+
+def call_functional(layer, param_arrays, buffer_arrays, args_arrays,
+                    kwargs_arrays=None, rng_key=None, fn=None):
+    """Pure function: (params, buffers, inputs[, rng]) -> (out, new_buffers).
+
+    `fn` defaults to layer.__call__; any Tensor-valued structure of outputs is
+    flattened to arrays.  Buffer mutations (BatchNorm running stats) during
+    the call are captured and returned so the jitted wrapper can write them
+    back to the eager layer afterwards.
+    """
+    pn, _, bn, _ = split_state(layer)
+    kwargs_arrays = kwargs_arrays or {}
+    with _swapped(layer, pn, param_arrays, bn, buffer_arrays) as (_, buffers):
+        ctx = _random.key_context(rng_key) if rng_key is not None else \
+            contextlib.nullcontext()
+        with ctx, engine.no_grad():
+            wrapped_args = [Tensor._from_array(a) if not isinstance(a, Tensor)
+                            else a for a in args_arrays]
+            target = fn or layer.__call__
+            out = target(*wrapped_args, **{
+                k: (Tensor._from_array(v) if _is_array(v) else v)
+                for k, v in kwargs_arrays.items()})
+        new_buffers = [buffers[n]._array for n in bn]
+    return _unwrap(out), new_buffers
+
+
+def _is_array(v):
+    import jax
+    import numpy as np
+    return isinstance(v, (jax.Array, np.ndarray))
+
+
+def _unwrap(out):
+    if isinstance(out, Tensor):
+        return out._array
+    if isinstance(out, (list, tuple)):
+        return type(out)(_unwrap(o) for o in out)
+    if isinstance(out, dict):
+        return {k: _unwrap(v) for k, v in out.items()}
+    return out
+
+
+def _rewrap(out, stop_gradient=True):
+    import jax
+    if isinstance(out, (jax.Array,)):
+        return Tensor._from_array(out, stop_gradient=stop_gradient)
+    if isinstance(out, (list, tuple)):
+        return type(out)(_rewrap(o, stop_gradient) for o in out)
+    if isinstance(out, dict):
+        return {k: _rewrap(v, stop_gradient) for k, v in out.items()}
+    return out

@@ -1,0 +1,94 @@
+"""Fully-fused train step: forward + backward + optimizer in ONE XLA program.
+
+This is the TPU-performance path the reference reaches via dy2static + CINN +
+fused optimizer kernels; here it's a single jax.jit with donated params/opt
+state (so weights update in-place in HBM) and value_and_grad for the backward.
+The Fleet distributed engine reuses this with sharding annotations
+(distributed/fleet_engine.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import random as _random
+from ..tensor import Tensor
+from . import functional_bridge as FB
+
+
+class TrainStep:
+    """step = TrainStep(model, loss_fn, optimizer)
+       loss = step(*batch)   # batch of Tensors
+
+    loss_fn(model, *batch) -> scalar loss Tensor, evaluated under trace.
+    """
+
+    def __init__(self, model, loss_fn, optimizer, donate=True):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self._jitted = None
+        self._donate = donate
+        self._opt_state = None
+        self._step = 0
+
+    def _build(self):
+        model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
+
+        def compute_loss(param_arrays, buffer_arrays, rng, batch_arrays):
+            out, new_buffers = FB.call_functional(
+                model, param_arrays, buffer_arrays, batch_arrays,
+                rng_key=rng, fn=lambda *ts: loss_fn(model, *ts))
+            loss = out
+            return loss, new_buffers
+
+        def step_fn(param_arrays, buffer_arrays, opt_state, lr, step, rng,
+                    batch_arrays):
+            (loss, new_buffers), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(
+                    param_arrays, buffer_arrays, rng, batch_arrays)
+            if optimizer._grad_clip is not None:
+                grads = optimizer._clip_grad_arrays(grads)
+            new_params, new_opt_state = optimizer.update(
+                grads, param_arrays, opt_state, lr, step)
+            return loss, new_params, new_buffers, new_opt_state
+
+        donate = (0, 2) if self._donate else ()
+        self._jitted = jax.jit(step_fn, donate_argnums=donate)
+
+    def __call__(self, *batch):
+        model, optimizer = self.model, self.optimizer
+        pn, pa, bn, ba = FB.split_state(model)
+        if self._opt_state is None:
+            # adopt any state the optimizer already has; else init
+            self._opt_state = optimizer._state or optimizer.init_state(pa)
+            optimizer._state = None  # fused step owns the state now
+        if self._jitted is None:
+            self._build()
+        self._step += 1
+        lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
+        step = jnp.asarray(self._step, jnp.float32)
+        rng = _random.next_key()
+        batch_arrays = tuple(
+            b._array if isinstance(b, Tensor) else jnp.asarray(b)
+            for b in batch)
+        loss, new_params, new_buffers, self._opt_state = self._jitted(
+            pa, ba, self._opt_state, lr, step, rng, batch_arrays)
+        params = dict(model.named_parameters())
+        for n, a in zip(pn, new_params):
+            params[n]._inplace_assign(a)
+        buffers = dict(model.named_buffers())
+        for n, a in zip(bn, new_buffers):
+            buffers[n]._inplace_assign(a)
+        optimizer._step_count = self._step
+        from ..optimizer.lr import LRScheduler
+        if isinstance(optimizer._lr, LRScheduler):
+            pass  # user steps the scheduler; lr is re-read every call
+        return Tensor._from_array(loss)
+
+    def state_dict(self):
+        return {"opt_state": self._opt_state, "step": self._step}
+
+
+def train_step(model, loss_fn, optimizer, donate=True):
+    return TrainStep(model, loss_fn, optimizer, donate=donate)

@@ -1,0 +1,73 @@
+"""paddle.linalg equivalent (reference: python/paddle/tensor/linalg.py)."""
+from __future__ import annotations
+
+from .ops import dispatch as ops
+from .tensor_api import _t
+
+
+def norm(x, p=None, axis=None, keepdim=False):
+    return ops.call("linalg_norm", _t(x), ord=p, axis=axis, keepdim=keepdim)
+
+
+def inv(x):
+    return ops.call("inverse", _t(x))
+
+
+def det(x):
+    return ops.call("det", _t(x))
+
+
+def slogdet(x):
+    return ops.call("slogdet", _t(x))
+
+
+def cholesky(x, upper=False):
+    return ops.call("cholesky", _t(x), upper=upper)
+
+
+def solve(a, b):
+    return ops.call("solve", _t(a), _t(b))
+
+
+def lstsq(a, b):
+    return ops.call("lstsq", _t(a), _t(b))
+
+
+def matrix_power(x, n):
+    return ops.call("matrix_power", _t(x), n=n)
+
+
+def pinv(x):
+    return ops.call("pinv", _t(x))
+
+
+def qr(x, mode="reduced"):
+    return ops.call("qr", _t(x), mode=mode)
+
+
+def svd(x, full_matrices=False):
+    return ops.call("svd", _t(x), full_matrices=full_matrices)
+
+
+def eigh(x, UPLO="L"):
+    return ops.call("eigh", _t(x), UPLO=UPLO)
+
+
+def eigvalsh(x, UPLO="L"):
+    return ops.call("eigvalsh", _t(x), UPLO=UPLO)
+
+
+def triangular_solve(a, b, upper=True, transpose=False, unitriangular=False):
+    return ops.call("triangular_solve", _t(a), _t(b), upper=upper,
+                    transpose=transpose, unitriangular=unitriangular)
+
+
+def matrix_rank(x, tol=None):
+    return ops.call("matrix_rank", _t(x), tol=tol)
+
+
+def multi_dot(xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out.matmul(x)
+    return out

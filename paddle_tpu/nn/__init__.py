@@ -1,0 +1,38 @@
+"""paddle_tpu.nn (reference surface: python/paddle/nn/__init__.py)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from . import utils_mod as utils  # noqa: F401
+from .layer import Layer  # noqa: F401
+from .common import (  # noqa: F401
+    Linear, Embedding, Dropout, Dropout2D, AlphaDropout, Flatten, Identity,
+    Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, Pad1D, Pad2D,
+    PixelShuffle, CosineSimilarity, Bilinear,
+    ReLU, ReLU6, GELU, SiLU, Swish, Mish, Sigmoid, Tanh, Hardswish,
+    Hardsigmoid, Hardtanh, LeakyReLU, ELU, CELU, SELU, Softplus, Softshrink,
+    Hardshrink, Softsign, Tanhshrink, LogSigmoid, Softmax, LogSoftmax, GLU,
+    PReLU,
+)
+from .container import (  # noqa: F401
+    Sequential, LayerList, LayerDict, ParameterList,
+)
+from .conv import Conv1D, Conv2D, Conv3D, Conv2DTranspose  # noqa: F401
+from .pooling import (  # noqa: F401
+    MaxPool2D, AvgPool2D, AdaptiveAvgPool2D, AdaptiveMaxPool2D, MaxPool1D,
+    AvgPool1D,
+)
+from .norm import (  # noqa: F401
+    LayerNorm, RMSNorm, GroupNorm, BatchNorm, BatchNorm1D, BatchNorm2D,
+    BatchNorm3D, SyncBatchNorm, InstanceNorm2D, LocalResponseNorm,
+)
+from .transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .rnn import SimpleRNN, LSTM, GRU, LSTMCell, GRUCell  # noqa: F401
+from .loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, NLLLoss, BCELoss,
+    BCEWithLogitsLoss, KLDivLoss, MarginRankingLoss, CosineEmbeddingLoss,
+)
+from .clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+)

@@ -1,0 +1,57 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py).
+
+Applied by the optimizer right before the update — eagerly on .grad tensors,
+or inside the fused jitted train step on the grad pytree (see
+optimizer/optimizer.py::Optimizer._clip_tree).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def _clip_arrays(self, grads):
+        """grads: list of jnp arrays → list of clipped jnp arrays."""
+        raise NotImplementedError
+
+    def __call__(self, params_grads):
+        # eager paddle-style interface: list[(param, grad Tensor)]
+        from ..tensor import Tensor
+        arrays = [g._array for _, g in params_grads]
+        clipped = self._clip_arrays(arrays)
+        return [(p, Tensor._from_array(c))
+                for (p, _), c in zip(params_grads, clipped)]
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _clip_arrays(self, grads):
+        return [jnp.clip(g, self.min, self.max) for g in grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_arrays(self, grads):
+        out = []
+        for g in grads:
+            n = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            out.append(g * scale)
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_arrays(self, grads):
+        total = jnp.sqrt(sum(
+            jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(total, 1e-12), 1.0)
+        return [(g.astype(jnp.float32) * scale).astype(g.dtype)
+                for g in grads]

@@ -1,0 +1,496 @@
+"""nn.functional (reference: python/paddle/nn/functional/*)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import dtypes
+from ..framework import random as _random
+from ..ops import dispatch as ops
+from ..tensor import Tensor, _coerce
+from ..tensor_api import _t
+
+# ------------------------------------------------------------- activations
+def relu(x): return ops.call("relu", _t(x))
+def relu6(x): return ops.call("relu6", _t(x))
+def relu_(x): return x._inplace_assign(ops.call_raw("relu", x._array))
+def sigmoid(x): return ops.call("sigmoid", _t(x))
+def tanh(x): return ops.call("tanh", _t(x))
+def silu(x): return ops.call("silu", _t(x))
+def swish(x): return ops.call("swish", _t(x))
+def mish(x): return ops.call("mish", _t(x))
+def hardswish(x): return ops.call("hardswish", _t(x))
+def hardsigmoid(x, slope=1/6, offset=0.5): return ops.call("hardsigmoid", _t(x))
+def selu(x): return ops.call("selu", _t(x))
+def softsign(x): return ops.call("softsign", _t(x))
+def tanhshrink(x): return ops.call("tanhshrink", _t(x))
+
+
+def gelu(x, approximate=False):
+    return ops.call("gelu", _t(x), approximate=approximate)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return ops.call("leaky_relu", _t(x), negative_slope=negative_slope)
+
+
+def elu(x, alpha=1.0):
+    return ops.call("elu", _t(x), alpha=alpha)
+
+
+def celu(x, alpha=1.0):
+    return ops.call("celu", _t(x), alpha=alpha)
+
+
+def softplus(x, beta=1.0, threshold=20.0):
+    return ops.call("softplus", _t(x), beta=beta, threshold=threshold)
+
+
+def softshrink(x, threshold=0.5):
+    return ops.call("softshrink", _t(x), threshold=threshold)
+
+
+def hardshrink(x, threshold=0.5):
+    return ops.call("hardshrink", _t(x), threshold=threshold)
+
+
+def hardtanh(x, min=-1.0, max=1.0):
+    return ops.call("hardtanh", _t(x), min=min, max=max)
+
+
+def prelu(x, weight):
+    return ops.call("prelu", _t(x), _t(weight))
+
+
+def glu(x, axis=-1):
+    return ops.call("glu", _t(x), axis=axis)
+
+
+def softmax(x, axis=-1, dtype=None):
+    out = ops.call("softmax", _t(x), axis=axis)
+    return out.cast(dtype) if dtype is not None else out
+
+
+def log_softmax(x, axis=-1, dtype=None):
+    out = ops.call("log_softmax", _t(x), axis=axis)
+    return out.cast(dtype) if dtype is not None else out
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    import jax
+    g = jax.random.gumbel(_random.next_key(), _t(x)._array.shape,
+                          _t(x)._array.dtype)
+    y = softmax((_t(x) + Tensor._from_array(g)) / temperature, axis=axis)
+    if hard:
+        idx = y._array.argmax(axis=axis, keepdims=True)
+        hard_arr = jnp.where(
+            jnp.arange(y._array.shape[axis]).reshape(
+                [-1 if d == (axis % y._array.ndim) else 1
+                 for d in range(y._array.ndim)]) == idx,
+            1.0, 0.0).astype(y._array.dtype)
+        # straight-through estimator: hard value, soft gradient
+        return Tensor._from_array(hard_arr - jax.lax.stop_gradient(
+            y._array) ) + y
+    return y
+
+
+# ------------------------------------------------------------------ linear
+def linear(x, weight, bias=None):
+    """x @ weight + bias; weight is [in, out] (reference layout)."""
+    out = ops.call("matmul", _t(x), _t(weight))
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False):
+    ids = _t(x)._array
+    return ops.call("embedding", _t(weight), ids=ids, padding_idx=padding_idx)
+
+
+def one_hot(x, num_classes):
+    return ops.call("one_hot", _t(x), num_classes=int(num_classes))
+
+
+# ----------------------------------------------------------------- dropout
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train"):
+    if mode == "downscale_in_infer":
+        # reference semantics: no train-time scaling; scale at inference
+        if not training:
+            return _t(x) * (1.0 - p)
+        if p == 0.0:
+            return _t(x)
+        key = _random.next_key()
+        return ops.call("dropout_nodiv_k", _t(x), key=key, p=float(p))
+    if not training or p == 0.0:
+        return _t(x)
+    key = _random.next_key()
+    return ops.call("dropout_k", _t(x), key=key, p=float(p))
+
+
+def dropout2d(x, p=0.5, training=True):
+    if not training or p == 0.0:
+        return _t(x)
+    xt = _t(x)
+    key = _random.next_key()
+    import jax
+    mask = jax.random.bernoulli(key, 1.0 - p, xt._array.shape[:2] + (1, 1))
+    m = Tensor._from_array(mask.astype(xt._array.dtype) / (1.0 - p))
+    return xt * m
+
+
+def alpha_dropout(x, p=0.5, training=True):
+    return dropout(x, p, training=training)
+
+
+# -------------------------------------------------------------- conv / pool
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    out = ops.call("conv2d", _t(x), _t(weight), stride=stride,
+                   padding=padding, dilation=dilation, groups=groups,
+                   data_format=data_format)
+    if bias is not None:
+        shape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
+        out = out + bias.reshape(shape)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    out = ops.call("conv1d", _t(x), _t(weight), stride=stride,
+                   padding=padding, dilation=dilation, groups=groups)
+    if bias is not None:
+        out = out + bias.reshape([1, -1, 1])
+    return out
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    out = ops.call("conv3d", _t(x), _t(weight), stride=stride,
+                   padding=padding, dilation=dilation, groups=groups)
+    if bias is not None:
+        out = out + bias.reshape([1, -1, 1, 1, 1])
+    return out
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1):
+    out = ops.call("conv2d_transpose", _t(x), _t(weight), stride=stride,
+                   padding=padding, output_padding=output_padding,
+                   dilation=dilation, groups=groups)
+    if bias is not None:
+        out = out + bias.reshape([1, -1, 1, 1])
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False):
+    return ops.call("max_pool2d", _t(x), kernel_size=kernel_size,
+                    stride=stride, padding=padding, ceil_mode=ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True):
+    return ops.call("avg_pool2d", _t(x), kernel_size=kernel_size,
+                    stride=stride, padding=padding, ceil_mode=ceil_mode,
+                    exclusive=exclusive)
+
+
+def adaptive_avg_pool2d(x, output_size):
+    return ops.call("adaptive_avg_pool2d", _t(x), output_size=output_size)
+
+
+def adaptive_max_pool2d(x, output_size):
+    return ops.call("adaptive_max_pool2d", _t(x), output_size=output_size)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False):
+    return ops.call("interpolate", _t(x), size=size,
+                    scale_factor=scale_factor, mode=mode,
+                    align_corners=align_corners)
+
+
+upsample = interpolate
+
+
+def pixel_shuffle(x, upscale_factor):
+    return ops.call("pixel_shuffle", _t(x), upscale_factor=upscale_factor)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    from .. import tensor_api
+    return tensor_api.pad(x, pad, mode=mode, value=value,
+                          data_format=data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    import jax
+    k = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else \
+        (kernel_sizes, kernel_sizes)
+    xt = _t(x)._array
+    n, c, h, w = xt.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        xt, filter_shape=tuple(k),
+        window_strides=(strides, strides) if isinstance(strides, int)
+        else tuple(strides),
+        padding=[(paddings, paddings)] * 2 if isinstance(paddings, int)
+        else [(p, p) for p in paddings])
+    n2, ckk, oh, ow = patches.shape
+    return Tensor._from_array(patches.reshape(n2, ckk, oh * ow))
+
+
+# ------------------------------------------------------------------- norms
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    if isinstance(normalized_shape, int):
+        ndim = 1
+    else:
+        ndim = len(normalized_shape)
+    args = [_t(x)]
+    w = _t(weight) if weight is not None else None
+    b = _t(bias) if bias is not None else None
+    if w is not None and b is not None:
+        return ops.call("layer_norm", args[0], w, b,
+                        normalized_ndim=ndim, eps=epsilon)
+    # build partial application without optional params
+    def k(x_, **kw):
+        return ops.call_raw("layer_norm", x_, None, None, **kw)
+    from ..autograd import engine
+    return engine.apply("layer_norm", k, [args[0]],
+                        {"normalized_ndim": ndim, "eps": epsilon})
+
+
+def rms_norm(x, weight=None, epsilon=1e-6):
+    if weight is not None:
+        return ops.call("rms_norm", _t(x), _t(weight), eps=epsilon)
+    from ..autograd import engine
+    return engine.apply("rms_norm", lambda x_, **kw: ops.call_raw(
+        "rms_norm", x_, None, **kw), [_t(x)], {"eps": epsilon})
+
+
+def _ones_like_channels(x, n):
+    return Tensor._from_array(jnp.ones((n,), jnp.float32))
+
+
+def _zeros_like_channels(x, n):
+    return Tensor._from_array(jnp.zeros((n,), jnp.float32))
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5):
+    c = _t(x).shape[1]
+    w = _t(weight) if weight is not None else _ones_like_channels(x, c)
+    b = _t(bias) if bias is not None else _zeros_like_channels(x, c)
+    return ops.call("group_norm", _t(x), w, b,
+                    num_groups=num_groups, eps=epsilon)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW"):
+    c = _t(x).shape[1 if data_format.startswith("NC") else -1]
+    if weight is None:
+        weight = _ones_like_channels(x, c)
+    if bias is None:
+        bias = _zeros_like_channels(x, c)
+    axis = 1 if data_format.startswith("NC") or _t(x).ndim <= 2 else \
+        _t(x).ndim - 1
+    if _t(x).ndim == 2:
+        axis = 1
+    if not training:
+        return ops.call("batch_norm_infer", _t(x), _t(weight), _t(bias),
+                        _t(running_mean), _t(running_var),
+                        eps=epsilon, axis=axis)
+    out, mean, var = ops.call("batch_norm_train", _t(x), _t(weight),
+                              _t(bias), eps=epsilon, axis=axis)
+    # update running stats in place (buffers), paddle momentum convention:
+    # running = momentum * running + (1 - momentum) * batch
+    n = _t(x)._array.size // _t(x)._array.shape[axis]
+    unbiased = var._array * (n / max(n - 1, 1))
+    running_mean._inplace_assign(
+        momentum * running_mean._array
+        + (1.0 - momentum) * mean._array.astype(running_mean._array.dtype))
+    running_var._inplace_assign(
+        momentum * running_var._array
+        + (1.0 - momentum) * unbiased.astype(running_var._array.dtype))
+    return out
+
+
+def normalize(x, p=2.0, axis=1, epsilon=1e-12):
+    xt = _t(x)
+    denom = xt.norm(p=p, axis=axis, keepdim=True).clip(min=epsilon)
+    return xt / denom
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    a, b = _t(x1), _t(x2)
+    num = (a * b).sum(axis=axis)
+    d1 = a.norm(axis=axis)
+    d2 = b.norm(axis=axis)
+    return num / (d1 * d2).clip(min=eps)
+
+
+# --------------------------------------------------------------- attention
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, scale=None):
+    """(B, L, H, D) layout. Dispatches to the pallas flash kernel on TPU via
+    the op registry override; XLA reference path otherwise."""
+    q, k, v = _t(query), _t(key), _t(value)
+    if attn_mask is not None:
+        out = ops.call("sdpa", q, k, v, _t(attn_mask),
+                       is_causal=is_causal, scale=scale)
+    else:
+        from ..autograd import engine
+        out = engine.apply(
+            "sdpa",
+            lambda q_, k_, v_, **kw: ops.call_raw("sdpa", q_, k_, v_, None, **kw),
+            [q, k, v], {"is_causal": is_causal, "scale": scale})
+    if dropout_p > 0.0 and training:
+        out = dropout(out, dropout_p, training=training)
+    return out
+
+
+# ------------------------------------------------------------------ losses
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  label_smoothing=0.0):
+    loss = ops.call("softmax_ce", _t(input), _t(label),
+                    soft_label=soft_label, ignore_index=ignore_index,
+                    label_smoothing=label_smoothing, axis=axis)
+    if weight is not None and not soft_label:
+        w = ops.call("embedding", _t(weight),
+                     ids=jnp.clip(_t(label)._array, 0, None))
+        loss = loss * w
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return loss.sum()
+    if not soft_label:
+        valid = Tensor._from_array(
+            (_t(label)._array != ignore_index).astype(loss._array.dtype))
+        if weight is not None:
+            denom = (w * valid).sum()  # weighted mean over valid labels
+        else:
+            denom = valid.sum()
+        return loss.sum() / denom.clip(min=1e-12)
+    return loss.mean()
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1,
+                               ignore_index=-100):
+    lbl = _t(label)
+    if not soft_label and lbl.ndim == _t(logits).ndim:
+        lbl = lbl.squeeze(axis)
+    out = ops.call("softmax_ce", _t(logits), lbl, soft_label=soft_label,
+                   ignore_index=ignore_index, axis=axis)
+    return out.unsqueeze(axis)
+
+
+def mse_loss(input, label, reduction="mean"):
+    d = (_t(input) - _t(label))
+    loss = d * d
+    return _reduce_loss(loss, reduction)
+
+
+def l1_loss(input, label, reduction="mean"):
+    loss = (_t(input) - _t(label)).abs()
+    return _reduce_loss(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    from ..autograd import engine
+    loss = engine.apply(
+        "smooth_l1",
+        lambda a, b, delta: jnp.where(
+            jnp.abs(a - b) < delta,
+            0.5 * jnp.square(a - b) / delta,
+            jnp.abs(a - b) - 0.5 * delta),
+        [_t(input), _t(label)], {"delta": delta})
+    return _reduce_loss(loss, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    from ..autograd import engine
+    lbl = _t(label)._array
+    w_arr = _t(weight)._array if weight is not None else None
+
+    def k(logp):
+        picked = jnp.take_along_axis(
+            logp, jnp.clip(lbl, 0, None)[..., None], axis=-1).squeeze(-1)
+        loss = -picked
+        if w_arr is not None:
+            loss = loss * w_arr[jnp.clip(lbl, 0, None)]
+        return jnp.where(lbl != ignore_index, loss, 0.0)
+
+    loss = engine.apply("nll", k, [_t(input)])
+    if reduction == "mean":
+        valid = (lbl != ignore_index)
+        if w_arr is not None:
+            denom = (w_arr[jnp.clip(lbl, 0, None)] * valid).sum()
+        else:
+            denom = valid.sum()
+        return loss.sum() / Tensor._from_array(
+            jnp.clip(denom.astype(loss._array.dtype), 1e-12, None))
+    return _reduce_loss(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    from ..autograd import engine
+
+    def k(p, y):
+        p_ = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        return -(y * jnp.log(p_) + (1.0 - y) * jnp.log(1.0 - p_))
+
+    loss = engine.apply("bce", k, [_t(input), _t(label)])
+    if weight is not None:
+        loss = loss * _t(weight)
+    return _reduce_loss(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    if pos_weight is not None:
+        loss = ops.call("bce_with_logits", _t(logit), _t(label),
+                        _t(pos_weight))
+    else:
+        from ..autograd import engine
+        loss = engine.apply(
+            "bce_logits",
+            lambda lg, y: ops.call_raw("bce_with_logits", lg, y, None),
+            [_t(logit), _t(label)])
+    if weight is not None:
+        loss = loss * _t(weight)
+    return _reduce_loss(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean"):
+    from ..autograd import engine
+
+    def k(logp, y):
+        return y * (jnp.log(jnp.clip(y, 1e-12, None)) - logp)
+
+    loss = engine.apply("kl_div", k, [_t(input), _t(label)])
+    if reduction == "batchmean":
+        return loss.sum() / _t(input).shape[0]
+    return _reduce_loss(loss, reduction)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    lt = _t(label)
+    n = lt.shape[-1]
+    if prior_dist is not None:
+        return (1.0 - epsilon) * lt + epsilon * _t(prior_dist)
+    return (1.0 - epsilon) * lt + epsilon / n
+
+
+def _reduce_loss(loss, reduction):
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return loss.sum()
+    return loss.mean()
+
+
+def sequence_mask(lengths, maxlen=None, dtype="bool"):
+    lt = _t(lengths)._array
+    m = int(maxlen) if maxlen is not None else int(lt.max())
+    mask = jnp.arange(m)[None, :] < lt[..., None]
+    return Tensor._from_array(mask.astype(dtypes.convert_dtype(dtype)))

@@ -1,0 +1,178 @@
+"""Parameter initializers (reference: python/paddle/nn/initializer/*)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import random as _random
+
+
+class Initializer:
+    def __call__(self, tensor):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, t):
+        t._inplace_assign(jnp.full_like(t._array, self.value))
+        return t
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, t):
+        arr = getattr(self.value, "_array", None)
+        if arr is None:
+            arr = jnp.asarray(self.value)
+        t._inplace_assign(arr.astype(t._array.dtype).reshape(t._array.shape))
+        return t
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, t):
+        k = _random.next_key()
+        t._inplace_assign(
+            jax.random.normal(k, t._array.shape, jnp.float32).astype(
+                t._array.dtype) * self.std + self.mean)
+        return t
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, t):
+        k = _random.next_key()
+        v = jax.random.truncated_normal(k, -2.0, 2.0, t._array.shape,
+                                        jnp.float32)
+        t._inplace_assign((v * self.std + self.mean).astype(t._array.dtype))
+        return t
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, t):
+        k = _random.next_key()
+        t._inplace_assign(jax.random.uniform(
+            k, t._array.shape, jnp.float32, self.low, self.high).astype(
+                t._array.dtype))
+        return t
+
+
+def _fans(shape):
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv OIHW: receptive = prod(spatial)
+    receptive = 1
+    for s in shape[2:]:
+        receptive *= s
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, t):
+        fi, fo = _fans(t._array.shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return Uniform(-limit, limit)(t)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, t):
+        fi, fo = _fans(t._array.shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return Normal(0.0, std)(t)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, negative_slope=0.0, nonlinearity="leaky_relu",
+                 fan_in=None):
+        self.a, self.fan_in = negative_slope, fan_in
+
+    def __call__(self, t):
+        fi, _ = _fans(t._array.shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.a ** 2))
+        limit = gain * math.sqrt(3.0 / fi)
+        return Uniform(-limit, limit)(t)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, negative_slope=0.0, nonlinearity="leaky_relu",
+                 fan_in=None):
+        self.a, self.fan_in = negative_slope, fan_in
+
+    def __call__(self, t):
+        fi, _ = _fans(t._array.shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.a ** 2))
+        return Normal(0.0, gain / math.sqrt(fi))(t)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, t):
+        k = _random.next_key()
+        shape = t._array.shape
+        rows = shape[0]
+        cols = t._array.size // rows
+        a = jax.random.normal(k, (max(rows, cols), min(rows, cols)),
+                              jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        t._inplace_assign(
+            (self.gain * q[:rows, :cols]).reshape(shape).astype(
+                t._array.dtype))
+        return t
+
+
+class Dirac(Initializer):
+    def __call__(self, t):
+        shape = t._array.shape  # OIHW
+        arr = jnp.zeros(shape, t._array.dtype)
+        m = min(shape[0], shape[1])
+        centers = tuple(s // 2 for s in shape[2:])
+        idx = (jnp.arange(m), jnp.arange(m)) + centers
+        arr = arr.at[idx].set(1.0)
+        t._inplace_assign(arr)
+        return t
+
+
+def calculate_gain(nonlinearity, param=None):
+    if nonlinearity == "tanh":
+        return 5.0 / 3
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        a = param if param is not None else 0.01
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity == "selu":
+        return 3.0 / 4
+    return 1.0

@@ -1,0 +1,172 @@
+"""Normalization layers (reference: python/paddle/nn/layer/norm.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from . import functional as F
+from . import initializer as I
+from .common import _attr_init
+from .layer import Layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self.normalized_shape = list(normalized_shape)
+        self.epsilon = epsilon
+        self.weight = None if weight_attr is False else self.create_parameter(
+            self.normalized_shape,
+            default_initializer=_attr_init(weight_attr) or I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            self.normalized_shape, is_bias=True,
+            default_initializer=_attr_init(bias_attr) or I.Constant(0.0))
+
+    def forward(self, x):
+        return F.layer_norm(x, self.normalized_shape, self.weight, self.bias,
+                            self.epsilon)
+
+    def extra_repr(self):
+        return f"{self.normalized_shape}, eps={self.epsilon}"
+
+
+class RMSNorm(Layer):
+    def __init__(self, hidden_size, epsilon=1e-6, weight_attr=None):
+        super().__init__()
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            [hidden_size],
+            default_initializer=_attr_init(weight_attr) or I.Constant(1.0))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self.epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.num_groups = num_groups
+        self.epsilon = epsilon
+        self.weight = None if weight_attr is False else self.create_parameter(
+            [num_channels], default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_channels], is_bias=True,
+            default_initializer=I.Constant(0.0))
+
+    def forward(self, x):
+        return F.group_norm(x, self.num_groups, self.weight, self.bias,
+                            self.epsilon)
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.use_global_stats = use_global_stats
+        self.weight = None if weight_attr is False else self.create_parameter(
+            [num_features],
+            default_initializer=_attr_init(weight_attr) or I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_features], is_bias=True,
+            default_initializer=_attr_init(bias_attr) or I.Constant(0.0))
+        self.register_buffer("_mean",
+                             Tensor(jnp.zeros(num_features, jnp.float32)))
+        self.register_buffer("_variance",
+                             Tensor(jnp.ones(num_features, jnp.float32)))
+
+    def forward(self, x):
+        training = self.training and not self.use_global_stats
+        return F.batch_norm(x, self._mean, self._variance, self.weight,
+                            self.bias, training=training,
+                            momentum=self.momentum, epsilon=self.epsilon,
+                            data_format=self.data_format)
+
+    def extra_repr(self):
+        return f"{self.num_features}, momentum={self.momentum}"
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+class BatchNorm(_BatchNormBase):
+    """dygraph-style BatchNorm (reference paddle.nn.BatchNorm)."""
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """On TPU, batch stats sync across data-parallel shards happens inside the
+    pjit'd step automatically when batch dims are sharded (XLA computes global
+    reductions); eagerly this is identical to BatchNorm.
+
+    Reference: python/paddle/nn/layer/norm.py::SyncBatchNorm."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        for l in layer.sublayers(include_self=True):
+            for name, sub in list(l._sub_layers.items()):
+                if isinstance(sub, _BatchNormBase) and not isinstance(
+                        sub, SyncBatchNorm):
+                    sync = SyncBatchNorm(sub.num_features, sub.momentum,
+                                         sub.epsilon)
+                    if sub.weight is not None:
+                        sync.weight.set_value(sub.weight)
+                        sync.bias.set_value(sub.bias)
+                    sync._mean.set_value(sub._mean)
+                    sync._variance.set_value(sub._variance)
+                    l._sub_layers[name] = sync
+        return layer
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.epsilon = epsilon
+        self.weight = None if weight_attr is False else self.create_parameter(
+            [num_features], default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_features], is_bias=True,
+            default_initializer=I.Constant(0.0))
+
+    def forward(self, x):
+        # instance norm == group norm with one group per channel
+        return F.group_norm(x, x.shape[1], self.weight, self.bias,
+                            self.epsilon)
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+
+    def forward(self, x):
+        from ..autograd import engine
+
+        def kfn(a, size, alpha, beta, k):
+            sq = jnp.square(a)
+            pad = [(0, 0), (size // 2, (size - 1) // 2)] + \
+                [(0, 0)] * (a.ndim - 2)
+            sq = jnp.pad(sq, pad)
+            acc = sum(sq[:, i:i + a.shape[1]] for i in range(size))
+            return a / jnp.power(k + alpha * acc, beta)
+
+        return engine.apply("lrn", kfn, [x],
+                            {"size": self.size, "alpha": self.alpha,
+                             "beta": self.beta, "k": self.k})

@@ -1,0 +1,274 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-native: the time loop is a jax.lax.scan inside one recorded op, so the
+whole sequence compiles to a single fused XLA while-loop instead of a Python
+loop of kernel launches.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import engine
+from ..tensor import Tensor
+from . import initializer as I
+from .layer import Layer
+
+
+class _RNNBase(Layer):
+    MODE = "RNN_TANH"
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        ndir = 2 if self.bidirectional else 1
+        self.num_directions = ndir
+        g = self.GATES
+        std = 1.0 / math.sqrt(hidden_size)
+        for l in range(num_layers):
+            for d in range(ndir):
+                in_sz = input_size if l == 0 else hidden_size * ndir
+                sfx = f"_l{l}" + ("_reverse" if d else "")
+                self.add_parameter(
+                    f"weight_ih{sfx}", self.create_parameter(
+                        [g * hidden_size, in_sz],
+                        default_initializer=I.Uniform(-std, std)))
+                self.add_parameter(
+                    f"weight_hh{sfx}", self.create_parameter(
+                        [g * hidden_size, hidden_size],
+                        default_initializer=I.Uniform(-std, std)))
+                self.add_parameter(
+                    f"bias_ih{sfx}", self.create_parameter(
+                        [g * hidden_size],
+                        default_initializer=I.Uniform(-std, std)))
+                self.add_parameter(
+                    f"bias_hh{sfx}", self.create_parameter(
+                        [g * hidden_size],
+                        default_initializer=I.Uniform(-std, std)))
+
+    def _cell(self, x, h, c, w_ih, w_hh, b_ih, b_hh):
+        raise NotImplementedError
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # inputs: [B, T, C] (batch-major default, like the reference)
+        has_cell = self.MODE == "LSTM"
+        x = inputs
+        if self.time_major:
+            x = x.transpose([1, 0, 2])
+        B = x.shape[0]
+        H = self.hidden_size
+        L, ND = self.num_layers, self.num_directions
+
+        params, names = [], []
+        for l in range(L):
+            for d in range(ND):
+                sfx = f"_l{l}" + ("_reverse" if d else "")
+                for p in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+                    params.append(getattr(self, p + sfx))
+                    names.append(p + sfx)
+
+        if initial_states is None:
+            z = jnp.zeros((L * ND, B, H), x._array.dtype)
+            init_h = Tensor._from_array(z)
+            init_c = Tensor._from_array(z) if has_cell else None
+        else:
+            init_h, init_c = (initial_states if has_cell
+                              else (initial_states, None))
+
+        cell = self._cell_fn()
+        mode_has_cell = has_cell
+
+        def rnn_fn(x_arr, ih, ic, *param_arrays):
+            pm = {n: a for n, a in zip(names, param_arrays)}
+            layer_in = x_arr
+            last_h, last_c = [], []
+            for l in range(L):
+                outs = []
+                for d in range(ND):
+                    sfx = f"_l{l}" + ("_reverse" if d else "")
+                    w_ih, w_hh = pm["weight_ih" + sfx], pm["weight_hh" + sfx]
+                    b_ih, b_hh = pm["bias_ih" + sfx], pm["bias_hh" + sfx]
+                    seq = jnp.flip(layer_in, 1) if d else layer_in
+                    h0 = ih[l * ND + d]
+                    c0 = ic[l * ND + d] if mode_has_cell else jnp.zeros_like(h0)
+
+                    def step(carry, xt):
+                        h, c = carry
+                        h2, c2 = cell(xt, h, c, w_ih, w_hh, b_ih, b_hh)
+                        return (h2, c2), h2
+
+                    (hT, cT), ys = jax.lax.scan(
+                        step, (h0, c0), jnp.swapaxes(seq, 0, 1))
+                    ys = jnp.swapaxes(ys, 0, 1)
+                    if d:
+                        ys = jnp.flip(ys, 1)
+                    outs.append(ys)
+                    last_h.append(hT)
+                    last_c.append(cT)
+                layer_in = jnp.concatenate(outs, -1) if ND == 2 else outs[0]
+            out = layer_in
+            hs = jnp.stack(last_h, 0)
+            if mode_has_cell:
+                return out, hs, jnp.stack(last_c, 0)
+            return out, hs
+
+        tensor_args = [x, init_h] + ([init_c] if has_cell else
+                                     [Tensor._from_array(
+                                         jnp.zeros((L * ND, B, H),
+                                                   x._array.dtype))]) + params
+        result = engine.apply(self.MODE.lower(), rnn_fn, tensor_args)
+        if self.time_major:
+            out = result[0].transpose([1, 0, 2])
+        else:
+            out = result[0]
+        if has_cell:
+            return out, (result[1], result[2])
+        return out, result[1]
+
+    def _cell_fn(self):
+        raise NotImplementedError
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+    GATES = 1
+
+    def __init__(self, *args, activation="tanh", **kwargs):
+        self._act = jnp.tanh if activation == "tanh" else jax.nn.relu
+        super().__init__(*args, **kwargs)
+
+    def _cell_fn(self):
+        act = self._act
+
+        def cell(xt, h, c, w_ih, w_hh, b_ih, b_hh):
+            h2 = act(xt @ w_ih.T + b_ih + h @ w_hh.T + b_hh)
+            return h2, c
+        return cell
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+    GATES = 4
+
+    def _cell_fn(self):
+        H = self.hidden_size
+
+        def cell(xt, h, c, w_ih, w_hh, b_ih, b_hh):
+            gates = xt @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c2 = f * c + i * g
+            h2 = o * jnp.tanh(c2)
+            return h2, c2
+        return cell
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+    GATES = 3
+
+    def _cell_fn(self):
+        H = self.hidden_size
+
+        def cell(xt, h, c, w_ih, w_hh, b_ih, b_hh):
+            gi = xt @ w_ih.T + b_ih
+            gh = h @ w_hh.T + b_hh
+            ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(in_ + r * hn)
+            h2 = (1.0 - z) * n + z * h
+            return h2, c
+        return cell
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size],
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size],
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        from .. import tensor_api as T
+        if states is None:
+            B = inputs.shape[0]
+            z = T.zeros([B, self.hidden_size], dtype=inputs._array.dtype)
+            states = (z, z)
+        h, c = states
+
+        def cell_fn(xt, h_, c_, w_ih, w_hh, b_ih, b_hh):
+            gates = xt @ w_ih.T + b_ih + h_ @ w_hh.T + b_hh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c2 = f * c_ + i * g
+            h2 = o * jnp.tanh(c2)
+            return h2, c2
+
+        h2, c2 = engine.apply(
+            "lstm_cell", cell_fn,
+            [inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih,
+             self.bias_hh])
+        return h2, (h2, c2)
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size],
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size],
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        from .. import tensor_api as T
+        if states is None:
+            states = T.zeros([inputs.shape[0], self.hidden_size],
+                             dtype=inputs._array.dtype)
+        h = states
+
+        def cell_fn(xt, h_, w_ih, w_hh, b_ih, b_hh):
+            gi = xt @ w_ih.T + b_ih
+            gh = h_ @ w_hh.T + b_hh
+            ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(in_ + r * hn)
+            return (1.0 - z) * n + z * h_
+
+        h2 = engine.apply(
+            "gru_cell", cell_fn,
+            [inputs, h, self.weight_ih, self.weight_hh, self.bias_ih,
+             self.bias_hh])
+        return h2, h2

@@ -1,0 +1,70 @@
+"""nn.utils (reference: python/paddle/nn/utils/*)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+
+def parameters_to_vector(parameters):
+    arrays = [p._array.reshape(-1) for p in parameters]
+    return Tensor._from_array(jnp.concatenate(arrays))
+
+
+def vector_to_parameters(vec, parameters):
+    offset = 0
+    for p in parameters:
+        n = p._array.size
+        p._inplace_assign(
+            vec._array[offset:offset + n].reshape(p._array.shape).astype(
+                p._array.dtype))
+        offset += n
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0):
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor._from_array(jnp.zeros(()))
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(
+        p.grad._array.astype(jnp.float32))) for p in params))
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in params:
+        p.grad._array = (p.grad._array * scale).astype(p.grad._array.dtype)
+    return Tensor._from_array(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._array = jnp.clip(p.grad._array, -clip_value, clip_value)
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Basic weight_norm: reparameterize at call time via a pre-hook."""
+    import jax.numpy as jnp
+    w = getattr(layer, name)
+    g = Tensor(jnp.linalg.norm(
+        w._array.reshape(w._array.shape[0], -1) if dim == 0 else w._array,
+        axis=1 if dim == 0 else None), stop_gradient=False)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", w)
+
+    def hook(l, inputs):
+        v = getattr(l, name + "_v")
+        gg = getattr(l, name + "_g")
+        norm = (v * v).sum(
+            axis=list(range(1, v.ndim)), keepdim=True).sqrt()
+        shape = [-1] + [1] * (v.ndim - 1)
+        l._parameters[name] = v / norm * gg.reshape(shape)
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12):
+    return layer  # placeholder: full implementation planned

@@ -1,0 +1,4 @@
+from . import dispatch  # noqa: F401
+from . import kernels  # noqa: F401  (populates the registry)
+from . import nn_kernels  # noqa: F401
+from .dispatch import register, override, call, call_raw  # noqa: F401

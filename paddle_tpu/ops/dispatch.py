@@ -1,0 +1,84 @@
+"""Op dispatch registry — the PHI kernel registry analog.
+
+Reference: paddle/phi/core/kernel_registry.h + kernel_factory.cc dispatch
+per (op, place, dtype).  TPU-native: one table name → pure-jax impl; dispatch
+applies the AMP policy (the auto_cast allow/deny lists that the reference
+implements in paddle/amp/auto_cast.py + imperative/amp_auto_cast.cc) and then
+records through the autograd engine.  Pallas kernels override entries at
+import time (ops/pallas/) the way PHI registers fused GPU kernels.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd import engine
+
+# amp policy values: "allow" (cast to amp dtype — matmul-class ops),
+# "deny" (compute in fp32 — numerically sensitive), "keep" (leave dtypes)
+_REGISTRY: dict = {}
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "amp")
+
+    def __init__(self, name, fn, amp):
+        self.name = name
+        self.fn = fn
+        self.amp = amp
+
+
+def register(name, fn=None, amp="keep"):
+    """Register a pure-jax kernel. Usable as decorator or direct call."""
+    def deco(f):
+        _REGISTRY[name] = OpDef(name, f, amp)
+        return f
+    if fn is not None:
+        return deco(fn)
+    return deco
+
+
+def override(name, fn):
+    """Swap an op's implementation (e.g. pallas flash-attention on TPU)."""
+    old = _REGISTRY[name].fn
+    _REGISTRY[name].fn = fn
+    return old
+
+
+def get(name) -> OpDef:
+    return _REGISTRY[name]
+
+
+def _amp_cast(tensors, policy):
+    from .. import amp
+    state = amp.amp_state()
+    if state is None:
+        return tensors
+    target = state.dtype
+    if state.level == "O2":
+        cast_to = jnp.float32 if policy == "deny" else target
+    else:  # O1
+        if policy == "allow":
+            cast_to = target
+        elif policy == "deny":
+            cast_to = jnp.float32
+        else:
+            return tensors
+    out = []
+    for t in tensors:
+        if jnp.issubdtype(t._array.dtype, jnp.floating) and t._array.dtype != cast_to:
+            out.append(t.cast(cast_to))
+        else:
+            out.append(t)
+    return out
+
+
+def call(name, *tensor_args, **consts):
+    """Dispatch: amp-cast → autograd-recorded execution of the kernel."""
+    op = _REGISTRY[name]
+    tensor_args = _amp_cast(list(tensor_args), op.amp)
+    return engine.apply(name, op.fn, tensor_args, consts)
+
+
+def call_raw(name, *arrays, **consts):
+    """Run the kernel on raw jax arrays (no tape, no amp) — for internal use."""
+    return _REGISTRY[name].fn(*arrays, **consts)

@@ -1,0 +1,438 @@
+"""Pure-jax kernels for every core op, registered into the dispatch table.
+
+Reference analog: the PHI op library (paddle/phi/kernels/*) — one entry per
+op, here lowered through jnp/lax so XLA tiles them onto the MXU/VPU and fuses
+elementwise chains.  AMP policy per op mirrors the reference's auto_cast
+allow/deny lists (python/paddle/amp/auto_cast.py).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .dispatch import register
+from ..framework import flags
+
+# ---------------------------------------------------------------- unary math
+_UNARY = {
+    "exp": jnp.exp, "expm1": jnp.expm1, "log": jnp.log, "log2": jnp.log2,
+    "log10": jnp.log10, "log1p": jnp.log1p, "sqrt": jnp.sqrt,
+    "rsqrt": lax.rsqrt, "abs": jnp.abs, "neg": jnp.negative,
+    "sign": jnp.sign, "floor": jnp.floor, "ceil": jnp.ceil,
+    "round": jnp.round, "trunc": jnp.trunc, "sin": jnp.sin, "cos": jnp.cos,
+    "tan": jnp.tan, "asin": jnp.arcsin, "acos": jnp.arccos,
+    "atan": jnp.arctan, "sinh": jnp.sinh, "cosh": jnp.cosh,
+    "tanh": jnp.tanh, "asinh": jnp.arcsinh, "acosh": jnp.arccosh,
+    "atanh": jnp.arctanh, "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "reciprocal": jnp.reciprocal, "square": jnp.square,
+    "sigmoid": jax.nn.sigmoid, "relu": jax.nn.relu, "relu6": jax.nn.relu6,
+    "silu": jax.nn.silu, "softplus_default": jax.nn.softplus,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "hardswish": jax.nn.hard_swish,
+    "hardsigmoid": lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0),
+    "isnan": jnp.isnan, "isinf": jnp.isinf, "isfinite": jnp.isfinite,
+    "logical_not": jnp.logical_not, "bitwise_not": jnp.bitwise_not,
+    "conj": jnp.conj, "real": jnp.real, "imag": jnp.imag,
+    "digamma": jax.scipy.special.digamma, "lgamma": jax.scipy.special.gammaln,
+    "i0": lambda x: jax.scipy.special.i0(x),
+    "frac": lambda x: x - jnp.trunc(x),
+}
+for _n, _f in _UNARY.items():
+    register(_n, _f)
+
+register("gelu", lambda x, approximate=False: jax.nn.gelu(
+    x, approximate=bool(approximate)))
+register("leaky_relu", lambda x, negative_slope=0.01: jax.nn.leaky_relu(
+    x, negative_slope))
+register("elu", lambda x, alpha=1.0: jax.nn.elu(x, alpha))
+register("celu", lambda x, alpha=1.0: jax.nn.celu(x, alpha))
+register("selu", lambda x: jax.nn.selu(x))
+register("softplus", lambda x, beta=1.0, threshold=20.0: jnp.where(
+    x * beta > threshold, x, jax.nn.softplus(x * beta) / beta))
+register("softsign", lambda x: x / (1 + jnp.abs(x)))
+register("hardtanh", lambda x, min=-1.0, max=1.0: jnp.clip(x, min, max))
+register("swish", lambda x: jax.nn.silu(x))
+register("tanhshrink", lambda x: x - jnp.tanh(x))
+register("softshrink", lambda x, threshold=0.5: jnp.where(
+    x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0.0)))
+register("hardshrink", lambda x, threshold=0.5: jnp.where(
+    jnp.abs(x) > threshold, x, 0.0))
+register("logit", lambda x, eps=None: jax.scipy.special.logit(
+    jnp.clip(x, eps, 1 - eps) if eps else x))
+register("cast", lambda x, dtype: x.astype(dtype))
+register("clip", lambda x, min=None, max=None: jnp.clip(x, min, max))
+register("nan_to_num", lambda x, nan=0.0, posinf=None, neginf=None:
+         jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf))
+
+# --------------------------------------------------------------- binary math
+_BINARY = {
+    "add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+    "divide": jnp.divide, "floor_divide": jnp.floor_divide,
+    "mod": jnp.mod, "remainder": jnp.remainder, "fmod": jnp.fmod,
+    "pow": jnp.power, "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "fmax": jnp.fmax, "fmin": jnp.fmin, "atan2": jnp.arctan2,
+    "hypot": jnp.hypot, "logaddexp": jnp.logaddexp,
+    "equal": jnp.equal, "not_equal": jnp.not_equal,
+    "greater_than": jnp.greater, "greater_equal": jnp.greater_equal,
+    "less_than": jnp.less, "less_equal": jnp.less_equal,
+    "logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+    "bitwise_and": jnp.bitwise_and, "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+    "left_shift": jnp.left_shift, "right_shift": jnp.right_shift,
+    "heaviside": jnp.heaviside, "nextafter": jnp.nextafter,
+    "copysign": jnp.copysign, "gcd": jnp.gcd, "lcm": jnp.lcm,
+    "dot": jnp.dot, "inner": jnp.inner, "outer": jnp.outer,
+    "kron": jnp.kron, "cross": jnp.cross,
+}
+for _n, _f in _BINARY.items():
+    register(_n, _f)
+
+register("lerp", lambda x, y, weight: x + weight * (y - x))
+register("addmm", lambda inp, x, y, beta=1.0, alpha=1.0:
+         beta * inp + alpha * (x @ y), amp="allow")
+register("scale", lambda x, scale=1.0, bias=0.0, bias_after_scale=True:
+         x * scale + bias if bias_after_scale else (x + bias) * scale)
+register("stanh", lambda x, scale_a=0.67, scale_b=1.7159:
+         scale_b * jnp.tanh(scale_a * x))
+
+# ------------------------------------------------------------------- matmul
+def _precision():
+    p = flags.get_flags("matmul_precision")
+    return p if p in ("high", "highest") else None
+
+
+@partial(register, "matmul", amp="allow")
+def _matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y, precision=_precision())
+
+
+register("bmm", lambda x, y: jnp.matmul(x, y, precision=_precision()),
+         amp="allow")
+register("mm", lambda x, y: jnp.matmul(x, y, precision=_precision()),
+         amp="allow")
+register("mv", lambda x, y: jnp.matmul(x, y, precision=_precision()),
+         amp="allow")
+
+
+@partial(register, "einsum", amp="allow")
+def _einsum(*arrays, equation):
+    return jnp.einsum(equation, *arrays, precision=_precision())
+
+
+# --------------------------------------------------------------- reductions
+def _reduce(fn):
+    def k(x, axis=None, keepdim=False):
+        return fn(x, axis=axis, keepdims=keepdim)
+    return k
+
+register("sum", _reduce(jnp.sum))
+register("mean", _reduce(jnp.mean))
+register("prod", _reduce(jnp.prod))
+register("max", _reduce(jnp.max))
+register("min", _reduce(jnp.min))
+register("amax", _reduce(jnp.max))
+register("amin", _reduce(jnp.min))
+register("all", _reduce(jnp.all))
+register("any", _reduce(jnp.any))
+register("logsumexp", lambda x, axis=None, keepdim=False:
+         jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim),
+         amp="deny")
+register("std", lambda x, axis=None, unbiased=True, keepdim=False:
+         jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim))
+register("var", lambda x, axis=None, unbiased=True, keepdim=False:
+         jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim))
+register("argmax", lambda x, axis=None, keepdim=False, dtype="int64":
+         _keep(jnp.argmax(x, axis=axis), x, axis, keepdim).astype(dtype))
+register("argmin", lambda x, axis=None, keepdim=False, dtype="int64":
+         _keep(jnp.argmin(x, axis=axis), x, axis, keepdim).astype(dtype))
+
+
+def _keep(r, x, axis, keepdim):
+    if keepdim and axis is not None:
+        r = jnp.expand_dims(r, axis)
+    return r
+
+
+register("cumsum", lambda x, axis=None:
+         jnp.cumsum(x if axis is not None else x.ravel(),
+                    axis=axis if axis is not None else 0))
+register("cumprod", lambda x, dim=None:
+         jnp.cumprod(x if dim is not None else x.ravel(),
+                     axis=dim if dim is not None else 0))
+register("cummax", lambda x, axis=0: lax.cummax(x, axis=axis))
+register("cummin", lambda x, axis=0: lax.cummin(x, axis=axis))
+register("logcumsumexp", lambda x, axis=0: lax.cumlogsumexp(x, axis=axis))
+register("count_nonzero", lambda x, axis=None, keepdim=False:
+         jnp.count_nonzero(x, axis=axis, keepdims=keepdim))
+register("median", lambda x, axis=None, keepdim=False:
+         jnp.median(x, axis=axis, keepdims=keepdim))
+register("quantile", lambda x, q, axis=None, keepdim=False:
+         jnp.quantile(x, q, axis=axis, keepdims=keepdim))
+register("nanmean", lambda x, axis=None, keepdim=False:
+         jnp.nanmean(x, axis=axis, keepdims=keepdim))
+register("nansum", lambda x, axis=None, keepdim=False:
+         jnp.nansum(x, axis=axis, keepdims=keepdim))
+
+
+@register("p_norm")
+def _p_norm(x, p=2.0, axis=None, keepdim=False):
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+# ------------------------------------------------------------- manipulation
+register("reshape", lambda x, shape: jnp.reshape(x, shape))
+register("transpose", lambda x, perm: jnp.transpose(x, perm))
+register("swapaxes", lambda x, a, b: jnp.swapaxes(x, a, b))
+register("flatten", lambda x, start_axis=0, stop_axis=-1:
+         _flatten(x, start_axis, stop_axis))
+
+
+def _flatten(x, start, stop):
+    nd = x.ndim
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    start %= nd
+    stop %= nd
+    shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return jnp.reshape(x, shape)
+
+
+register("squeeze", lambda x, axis=None: jnp.squeeze(x, axis=axis))
+register("unsqueeze", lambda x, axis: _unsqueeze(x, axis))
+
+
+def _unsqueeze(x, axis):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    for a in sorted(a if a >= 0 else a + x.ndim + 1 for a in axes):
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+@register("concat")
+def _concat(*xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+@register("stack")
+def _stack(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+@register("split")
+def _split(x, num_or_sections, axis=0):
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    sections, idx, cur = [], [], 0
+    total = x.shape[axis]
+    known = sum(s for s in num_or_sections if s != -1)
+    sizes = [s if s != -1 else total - known for s in num_or_sections]
+    for s in sizes[:-1]:
+        cur += s
+        idx.append(cur)
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+register("unbind", lambda x, axis=0: tuple(
+    jnp.squeeze(p, axis) for p in jnp.split(x, x.shape[axis], axis)))
+register("tile", lambda x, repeat_times: jnp.tile(x, repeat_times))
+register("expand", lambda x, shape: jnp.broadcast_to(
+    x, [s if s != -1 else xs for s, xs in
+        zip(shape, [1] * (len(shape) - x.ndim) + list(x.shape))]))
+register("broadcast_to", lambda x, shape: jnp.broadcast_to(x, shape))
+register("roll", lambda x, shifts, axis=None: jnp.roll(x, shifts, axis=axis))
+register("flip", lambda x, axis: jnp.flip(x, axis=axis))
+register("rot90", lambda x, k=1, axes=(0, 1): jnp.rot90(x, k, axes))
+register("repeat_interleave", lambda x, repeats, axis=None:
+         jnp.repeat(x, repeats, axis=axis))
+register("tril", lambda x, diagonal=0: jnp.tril(x, diagonal))
+register("triu", lambda x, diagonal=0: jnp.triu(x, diagonal))
+register("diag", lambda x, offset=0: jnp.diag(x, offset))
+register("diagonal", lambda x, offset=0, axis1=0, axis2=1:
+         jnp.diagonal(x, offset, axis1, axis2))
+register("diag_embed", lambda x, offset=0, dim1=-2, dim2=-1:
+         _diag_embed(x, offset, dim1, dim2))
+
+
+def _diag_embed(x, offset, dim1, dim2):
+    n = x.shape[-1] + abs(offset)
+    base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    i = jnp.arange(x.shape[-1])
+    out = base.at[..., i + max(-offset, 0), i + max(offset, 0)].set(x)
+    if (dim1, dim2) != (-2, -1):
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+@register("pad")
+def _pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    # paddle pad: flat list [lo_last, hi_last, lo_prev, hi_prev, ...] or per-dim
+    if len(pad) == 2 * x.ndim:
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        widths = [(0, 0)] * (x.ndim - len(pad) // 2)
+        tail = [(pad[i], pad[i + 1]) for i in range(0, len(pad), 2)]
+        widths += tail[::-1]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    kw = {"constant_values": value} if jmode == "constant" else {}
+    return jnp.pad(x, widths, mode=jmode, **kw)
+
+
+register("gather", lambda x, index, axis=0: jnp.take(x, index, axis=axis))
+register("index_select", lambda x, index, axis=0:
+         jnp.take(x, index, axis=axis))
+register("take_along_axis", lambda x, indices, axis:
+         jnp.take_along_axis(x, indices, axis=axis))
+# NOTE: kernels with a tensor `values/updates` operand take it as the 2nd
+# positional arg (dispatch passes tensor args positionally, consts as kwargs)
+register("put_along_axis", lambda x, values, indices, axis, reduce="assign":
+         _put_along(x, indices, values, axis, reduce))
+
+
+def _put_along(x, indices, values, axis, reduce):
+    values = jnp.broadcast_to(values, indices.shape).astype(x.dtype)
+    dims = list(range(x.ndim))
+    idx = tuple(
+        indices if d == axis else
+        jnp.arange(x.shape[d]).reshape(
+            [-1 if i == d else 1 for i in dims])
+        for d in dims)
+    if reduce == "assign":
+        return x.at[idx].set(values)
+    if reduce == "add":
+        return x.at[idx].add(values)
+    if reduce in ("multiply", "mul"):
+        return x.at[idx].multiply(values)
+    raise ValueError(reduce)
+
+
+@register("gather_nd")
+def _gather_nd(x, index):
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+@register("scatter")
+def _scatter(x, updates, index, overwrite=True):
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+register("scatter_nd_add", lambda x, updates, index:
+         x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates))
+register("index_add", lambda x, value, index, axis:
+         _index_axis(x, index, axis, value, "add"))
+register("index_fill", lambda x, index, axis, value:
+         _index_axis(x, index, axis, value, "set"))
+
+
+def _index_axis(x, index, axis, value, mode):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    ref = x.at[tuple(idx)]
+    return ref.add(value) if mode == "add" else ref.set(value)
+
+
+register("masked_fill", lambda x, mask, value: jnp.where(mask, value, x))
+register("where", lambda cond, x, y: jnp.where(cond, x, y))
+register("getitem", lambda x, index: x[index])
+register("setitem_", lambda x, value, index: x.at[index].set(
+    value.astype(x.dtype) if hasattr(value, "astype") else value))
+
+# sorting / search
+register("sort", lambda x, axis=-1, descending=False:
+         -jnp.sort(-x, axis=axis) if descending else jnp.sort(x, axis=axis))
+register("argsort", lambda x, axis=-1, descending=False:
+         jnp.argsort(-x, axis=axis) if descending else
+         jnp.argsort(x, axis=axis))
+
+
+@register("topk")
+def _topk(x, k, axis=-1, largest=True, sorted=True):
+    if axis != -1 and axis != x.ndim - 1:
+        xm = jnp.moveaxis(x, axis, -1)
+        v, i = _topk(xm, k, -1, largest, sorted)
+        return jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis)
+    if largest:
+        v, i = lax.top_k(x, k)
+    else:
+        v, i = lax.top_k(-x, k)
+        v = -v
+    return v, i
+
+
+register("searchsorted", lambda a, v, right=False:
+         jnp.searchsorted(a, v, side="right" if right else "left"))
+register("bincount", lambda x, minlength=0, length=None:
+         jnp.bincount(x, minlength=minlength, length=length))
+register("one_hot", lambda x, num_classes: jax.nn.one_hot(x, num_classes))
+register("bucketize", lambda x, edges, right=False:
+         jnp.searchsorted(edges, x, side="right" if right else "left"))
+
+# ------------------------------------------------------------------- linalg
+register("linalg_norm", lambda x, ord=None, axis=None, keepdim=False:
+         jnp.linalg.norm(x, ord=ord, axis=axis, keepdims=keepdim))
+register("inverse", jnp.linalg.inv)
+register("det", jnp.linalg.det)
+register("slogdet", lambda x: tuple(jnp.linalg.slogdet(x)))
+register("cholesky", lambda x, upper=False:
+         jnp.linalg.cholesky(x).swapaxes(-1, -2).conj() if upper
+         else jnp.linalg.cholesky(x))
+register("solve", jnp.linalg.solve)
+register("lstsq", lambda a, b: jnp.linalg.lstsq(a, b)[0])
+register("matrix_power", jnp.linalg.matrix_power)
+register("pinv", jnp.linalg.pinv)
+register("qr", lambda x, mode="reduced": tuple(jnp.linalg.qr(x, mode=mode)))
+register("svd", lambda x, full_matrices=False: tuple(
+    jnp.linalg.svd(x, full_matrices=full_matrices)))
+register("eigh", lambda x, UPLO="L": tuple(jnp.linalg.eigh(x, UPLO=UPLO)))
+register("eigvalsh", lambda x, UPLO="L": jnp.linalg.eigvalsh(x, UPLO=UPLO))
+register("triangular_solve", lambda a, b, upper=True, transpose=False,
+         unitriangular=False: jax.scipy.linalg.solve_triangular(
+             a, b, lower=not upper, trans=1 if transpose else 0,
+             unit_diagonal=unitriangular))
+register("trace_op", lambda x, offset=0, axis1=0, axis2=1:
+         jnp.trace(x, offset, axis1, axis2))
+register("matrix_rank", lambda x, tol=None: jnp.linalg.matrix_rank(x, tol=tol))
+
+# -------------------------------------------------------------- activations
+register("softmax", lambda x, axis=-1: jax.nn.softmax(x, axis=axis),
+         amp="deny")
+register("log_softmax", lambda x, axis=-1: jax.nn.log_softmax(x, axis=axis),
+         amp="deny")
+register("glu", lambda x, axis=-1: _glu(x, axis))
+
+
+def _glu(x, axis):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+register("prelu", lambda x, weight: jnp.where(x >= 0, x, weight * x))
+register("rrelu_eval", lambda x, lower=0.125, upper=0.333:
+         jnp.where(x >= 0, x, x * (lower + upper) / 2))
+
+# ------------------------------------------------------------ random kernels
+register("dropout_k", lambda x, key, p=0.5:
+         jnp.where(jax.random.bernoulli(key, 1.0 - p, x.shape), x / (1.0 - p),
+                   jnp.zeros_like(x)))
+register("dropout_nodiv_k", lambda x, key, p=0.5:
+         jnp.where(jax.random.bernoulli(key, 1.0 - p, x.shape), x,
+                   jnp.zeros_like(x)))
+register("uniform_k", lambda key, shape, dtype, min=0.0, max=1.0:
+         jax.random.uniform(key, shape, dtype, min, max))
+register("normal_k", lambda key, shape, dtype, mean=0.0, std=1.0:
+         jax.random.normal(key, shape, dtype) * std + mean)

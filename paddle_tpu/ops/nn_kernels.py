@@ -1,0 +1,345 @@
+"""Neural-net kernels (reference: paddle/phi/kernels/{conv,pool,norm,...}).
+
+All shapes follow the reference's conventions: conv/pool are NCHW with OIHW
+weights; attention is (batch, seq, heads, head_dim).  Everything lowers to
+lax/jnp so XLA maps convs+matmuls onto the MXU; `sdpa` is the XLA fallback
+that ops/pallas/flash_attention.py overrides on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .dispatch import register
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+def _conv_padding(padding, ndim):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * ndim
+    padding = list(padding)
+    if len(padding) == ndim:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * ndim:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                for i in range(ndim)]
+    raise ValueError(f"bad padding {padding}")
+
+
+@register("conv2d", amp="allow")
+def conv2d_k(x, w, stride=1, padding=0, dilation=1, groups=1,
+             data_format="NCHW"):
+    if data_format == "NHWC":
+        dn = ("NHWC", "OIHW", "NHWC")
+    else:
+        dn = ("NCHW", "OIHW", "NCHW")
+    return lax.conv_general_dilated(
+        x, w, window_strides=_pair(stride),
+        padding=_conv_padding(padding, 2),
+        rhs_dilation=_pair(dilation),
+        dimension_numbers=dn, feature_group_count=groups)
+
+
+@register("conv1d", amp="allow")
+def conv1d_k(x, w, stride=1, padding=0, dilation=1, groups=1):
+    s = (int(stride),) if isinstance(stride, int) else tuple(stride)
+    d = (int(dilation),) if isinstance(dilation, int) else tuple(dilation)
+    return lax.conv_general_dilated(
+        x, w, window_strides=s, padding=_conv_padding(padding, 1),
+        rhs_dilation=d, dimension_numbers=("NCH", "OIH", "NCH"),
+        feature_group_count=groups)
+
+
+@register("conv3d", amp="allow")
+def conv3d_k(x, w, stride=1, padding=0, dilation=1, groups=1):
+    def _tri(v):
+        return (int(v),) * 3 if isinstance(v, int) else tuple(v)
+    return lax.conv_general_dilated(
+        x, w, window_strides=_tri(stride), padding=_conv_padding(padding, 3),
+        rhs_dilation=_tri(dilation),
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups)
+
+
+@register("conv2d_transpose", amp="allow")
+def conv2d_transpose_k(x, w, stride=1, padding=0, output_padding=0,
+                       dilation=1, groups=1):
+    # weight layout IOHW (paddle conv2d_transpose), flip spatial dims
+    s = _pair(stride)
+    p = _conv_padding(padding, 2)
+    if isinstance(p, str):
+        raise ValueError("string padding unsupported for transpose conv")
+    k = w.shape[2:]
+    op = _pair(output_padding)
+    d = _pair(dilation)
+    pads = [
+        (d[i] * (k[i] - 1) - p[i][0],
+         d[i] * (k[i] - 1) - p[i][1] + op[i])
+        for i in range(2)
+    ]
+    w_t = jnp.flip(w, axis=(2, 3)).swapaxes(0, 1)  # IOHW→OIHW flipped
+    if groups > 1:
+        # grouped transpose: block-diagonal over channel groups
+        xs = jnp.split(x, groups, axis=1)
+        ws = jnp.split(w, groups, axis=0)
+        outs = [conv2d_transpose_k(xi, wi, stride, padding, output_padding,
+                                   dilation, 1) for xi, wi in zip(xs, ws)]
+        return jnp.concatenate(outs, axis=1)
+    return lax.conv_general_dilated(
+        x, w_t, window_strides=(1, 1), padding=pads,
+        lhs_dilation=s, rhs_dilation=_pair(dilation),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+def _ceil_extra(size, k, s, p):
+    """Extra high-side padding so reduce_window matches ceil_mode output."""
+    eff = size + p[0] + p[1]
+    out_floor = (eff - k) // s + 1
+    out_ceil = -(-(eff - k) // s) + 1
+    return (out_ceil - out_floor) * s
+
+
+@register("max_pool2d")
+def max_pool2d_k(x, kernel_size, stride=None, padding=0, ceil_mode=False):
+    k = _pair(kernel_size)
+    s = _pair(stride if stride is not None else kernel_size)
+    p = _conv_padding(padding, 2)
+    if isinstance(p, str):
+        raise ValueError("string padding unsupported for pool")
+    if ceil_mode:
+        p = [(p[i][0], p[i][1] + _ceil_extra(x.shape[2 + i], k[i], s[i],
+                                             p[i])) for i in range(2)]
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+        jnp.iinfo(x.dtype).min
+    return lax.reduce_window(
+        x, init, lax.max, (1, 1) + k, (1, 1) + s,
+        [(0, 0), (0, 0)] + list(p))
+
+
+@register("avg_pool2d")
+def avg_pool2d_k(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True):
+    k = _pair(kernel_size)
+    s = _pair(stride if stride is not None else kernel_size)
+    p = _conv_padding(padding, 2)
+    if ceil_mode:
+        p = [(p[i][0], p[i][1] + _ceil_extra(x.shape[2 + i], k[i], s[i],
+                                             p[i])) for i in range(2)]
+    win = (1, 1) + k
+    strides = (1, 1) + s
+    pads = [(0, 0), (0, 0)] + list(p)
+    summed = lax.reduce_window(x, 0.0, lax.add, win, strides, pads)
+    if exclusive and any(pi != (0, 0) for pi in p):
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, win, strides, pads)
+        return summed / jnp.maximum(counts, 1.0)
+    return summed / (k[0] * k[1])
+
+
+@register("adaptive_avg_pool2d")
+def adaptive_avg_pool2d_k(x, output_size):
+    oh, ow = _pair(output_size)
+    _, _, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        x4 = x.reshape(x.shape[0], x.shape[1], oh, h // oh, ow, w // ow)
+        return x4.mean(axis=(3, 5))
+    rows = []
+    for i in range(oh):
+        h0, h1 = (i * h) // oh, -(-((i + 1) * h) // oh)
+        cols = []
+        for j in range(ow):
+            w0, w1 = (j * w) // ow, -(-((j + 1) * w) // ow)
+            cols.append(x[:, :, h0:h1, w0:w1].mean(axis=(2, 3)))
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)
+
+
+@register("adaptive_max_pool2d")
+def adaptive_max_pool2d_k(x, output_size):
+    oh, ow = _pair(output_size)
+    _, _, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        x4 = x.reshape(x.shape[0], x.shape[1], oh, h // oh, ow, w // ow)
+        return x4.max(axis=(3, 5))
+    raise NotImplementedError("adaptive_max_pool2d: non-divisible sizes")
+
+
+@register("interpolate")
+def interpolate_k(x, size=None, scale_factor=None, mode="nearest",
+                  align_corners=False):
+    n, c, h, w = x.shape
+    if size is None:
+        sf = _pair(scale_factor) if not isinstance(scale_factor, float) \
+            else (scale_factor, scale_factor)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    size = _pair(size)
+    if align_corners and mode in ("bilinear", "linear") and \
+            size[0] > 1 and size[1] > 1:
+        # corner-aligned sampling grid (jax.image.resize is half-pixel only)
+        oh, ow = size
+        ys = jnp.linspace(0.0, h - 1.0, oh)
+        xs = jnp.linspace(0.0, w - 1.0, ow)
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 2)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 2)
+        wy = (ys - y0)[None, None, :, None]
+        wx = (xs - x0)[None, None, None, :]
+        g = x[:, :, y0][:, :, :, x0]
+        g01 = x[:, :, y0][:, :, :, x0 + 1]
+        g10 = x[:, :, y0 + 1][:, :, :, x0]
+        g11 = x[:, :, y0 + 1][:, :, :, x0 + 1]
+        top = g * (1 - wx) + g01 * wx
+        bot = g10 * (1 - wx) + g11 * wx
+        return top * (1 - wy) + bot * wy
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+              "bicubic": "cubic"}[mode]
+    return jax.image.resize(x, (n, c) + size, method=method)
+
+
+@register("pixel_shuffle")
+def pixel_shuffle_k(x, upscale_factor):
+    n, c, h, w = x.shape
+    r = upscale_factor
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = x.transpose(0, 1, 4, 2, 5, 3)
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+# ----------------------------------------------------------------- norms
+@register("layer_norm", amp="deny")
+def layer_norm_k(x, weight, bias, normalized_ndim=1, eps=1e-5):
+    axes = tuple(range(x.ndim - normalized_ndim, x.ndim))
+    mean = x.mean(axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register("rms_norm", amp="deny")
+def rms_norm_k(x, weight, eps=1e-6):
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = (xf * lax.rsqrt(ms + eps)).astype(dtype)
+    return out * weight if weight is not None else out
+
+
+@register("group_norm", amp="deny")
+def group_norm_k(x, weight, bias, num_groups, eps=1e-5):
+    n, c = x.shape[:2]
+    spatial = x.shape[2:]
+    xg = x.reshape(n, num_groups, c // num_groups, *spatial)
+    axes = tuple(range(2, xg.ndim))
+    mean = xg.mean(axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = ((xg - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+    shape = (1, c) + (1,) * len(spatial)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register("batch_norm_infer", amp="deny")
+def batch_norm_infer_k(x, weight, bias, mean, var, eps=1e-5, axis=1):
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    out = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + eps)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register("batch_norm_train", amp="deny")
+def batch_norm_train_k(x, weight, bias, eps=1e-5, axis=1):
+    axes = tuple(i for i in range(x.ndim) if i != axis)
+    mean = x.mean(axis=axes)
+    var = jnp.var(x, axis=axes)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    out = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + eps)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, mean, var
+
+
+# --------------------------------------------------------------- embedding
+@register("embedding")
+def embedding_k(w, ids, padding_idx=None):
+    if padding_idx is not None:
+        # the padding row contributes no gradient (reference semantics)
+        w = w.at[padding_idx].set(lax.stop_gradient(w[padding_idx]))
+    return jnp.take(w, ids, axis=0)
+
+
+# --------------------------------------------------------------- attention
+@register("sdpa", amp="allow")
+def sdpa_k(q, k, v, mask=None, is_causal=False, scale=None):
+    """Scaled dot-product attention, (B, L, H, D) layout like the reference's
+    nn.functional.scaled_dot_product_attention. Softmax in fp32."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    scores = jnp.einsum("blhd,bmhd->bhlm", q, k) * scale
+    scores = scores.astype(jnp.float32)
+    if is_causal:
+        lq, lk = scores.shape[-2], scores.shape[-1]
+        cm = jnp.tril(jnp.ones((lq, lk), bool), lk - lq)
+        scores = jnp.where(cm, scores, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, -jnp.inf)
+        else:
+            scores = scores + mask.astype(scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhlm,bmhd->blhd", probs, v)
+
+
+# ------------------------------------------------------------------ losses
+@register("softmax_ce", amp="deny")
+def softmax_ce_k(logits, label, soft_label=False, ignore_index=-100,
+                 label_smoothing=0.0, axis=-1):
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    n_cls = logits.shape[axis]
+    if soft_label:
+        tgt = label
+    else:
+        tgt = jax.nn.one_hot(label, n_cls, axis=axis, dtype=logp.dtype)
+    if label_smoothing > 0.0:
+        tgt = tgt * (1.0 - label_smoothing) + label_smoothing / n_cls
+    loss = -(tgt * logp).sum(axis=axis)
+    if not soft_label:
+        valid = (label != ignore_index)
+        loss = jnp.where(valid, loss, 0.0)
+    return loss
+
+
+@register("bce_with_logits", amp="deny")
+def bce_with_logits_k(logit, label, pos_weight=None):
+    logit = logit.astype(jnp.float32)
+    label = label.astype(jnp.float32)
+    max_val = jnp.clip(-logit, 0, None)
+    if pos_weight is not None:
+        log_weight = (pos_weight - 1.0) * label + 1.0
+        loss = (1.0 - label) * logit + log_weight * (
+            jnp.log(jnp.exp(-max_val) + jnp.exp(-logit - max_val)) + max_val)
+    else:
+        loss = (1.0 - label) * logit + max_val + jnp.log(
+            jnp.exp(-max_val) + jnp.exp(-logit - max_val))
+    return loss

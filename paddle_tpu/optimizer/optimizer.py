@@ -1,0 +1,208 @@
+"""Optimizer base (reference: python/paddle/optimizer/optimizer.py).
+
+Design: every optimizer is a *functional* update rule
+    update(grads, params, state, lr, step) -> (new_params, new_state)
+over flat lists of jax arrays.  The eager `.step()` jit-compiles that rule
+once (donating old params/state so XLA updates in place in HBM) — so even
+dygraph training runs the whole optimizer as one fused XLA program instead of
+per-op launches.  Fused train steps (jit/train_step.py) and the Fleet
+sharding engine call the same rule on sharded pytrees, which is how ZeRO
+stages fall out of sharding annotations rather than bespoke code.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+
+class Optimizer:
+    # state slot names, e.g. ("moment",) for Momentum
+    SLOTS: tuple = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False, apply_decay_param_fun=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters must be provided (dygraph-style optimizer)")
+        self._parameters = list(parameters)
+        self._param_names = [
+            p.name or f"param_{i}" for i, p in enumerate(self._parameters)]
+        self._lr = learning_rate
+        self._grad_clip = grad_clip
+        self._weight_decay = _decay_value(weight_decay)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._use_master_weights = multi_precision
+        self._state = None
+        self._step_count = 0
+        self._jitted = None
+
+    # ------------------------------------------------------------------- lr
+    def get_lr(self):
+        from .lr import LRScheduler
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        self._lr = float(value)
+
+    # ------------------------------------------------------------ state mgmt
+    def _init_state_for(self, arr):
+        """Return dict slot->initial array for one param."""
+        return {s: jnp.zeros_like(arr, dtype=jnp.float32) for s in self.SLOTS}
+
+    def init_state(self, param_arrays):
+        state = []
+        for a in param_arrays:
+            slots = self._init_state_for(a)
+            if self._use_master_weights and a.dtype in (
+                    jnp.bfloat16, jnp.float16):
+                slots["master"] = a.astype(jnp.float32)
+            state.append(slots)
+        return state
+
+    # -------------------------------------------------------- functional core
+    def _rule(self, g, p, slots, lr, step):
+        """Single-param update on fp32 arrays. Override in subclasses.
+        Returns (new_p, new_slots)."""
+        raise NotImplementedError
+
+    def _decayed_names(self):
+        if self._apply_decay_param_fun is None:
+            return set(self._param_names)
+        return {n for n in self._param_names
+                if self._apply_decay_param_fun(n)}
+
+    def update(self, grads, params, state, lr, step):
+        """Flat-list functional update; jit/pjit-safe."""
+        decay_mask = [n in self._decayed_names() for n in self._param_names]
+        new_params, new_state = [], []
+        for g, p, slots, dec in zip(grads, params, state, decay_mask):
+            if g is None:
+                new_params.append(p)
+                new_state.append(slots)
+                continue
+            compute_p = slots.get("master", p)
+            gf = g.astype(jnp.float32)
+            pf = compute_p.astype(jnp.float32)
+            gf = self._pre_grad(gf, pf, dec)
+            np_, ns = self._rule(gf, pf, dict(slots), lr, step)
+            np_ = self._post_param(np_, pf, dec, lr)
+            if "master" in slots:
+                ns["master"] = np_
+                new_params.append(np_.astype(p.dtype))
+            else:
+                new_params.append(np_.astype(p.dtype))
+            ns.pop("__tmp", None)
+            new_state.append(ns)
+        return new_params, new_state
+
+    def _pre_grad(self, g, p, decayed):
+        # coupled L2 (reference regularizer semantics: SGD/Momentum/Adam)
+        if self._weight_decay and self._couple_decay and decayed:
+            return g + self._weight_decay * p
+        return g
+
+    def _post_param(self, new_p, old_p, decayed, lr):
+        # decoupled decay (AdamW)
+        if self._weight_decay and not self._couple_decay and decayed:
+            return new_p - lr * self._weight_decay * old_p
+        return new_p
+
+    _couple_decay = True
+
+    # --------------------------------------------------------------- eager
+    def _clip_grad_arrays(self, grads):
+        if self._grad_clip is None:
+            return grads
+        present = [g for g in grads if g is not None]
+        clipped = iter(self._grad_clip._clip_arrays(present))
+        return [next(clipped) if g is not None else None for g in grads]
+
+    def step(self):
+        params = [p._array for p in self._parameters]
+        grads = [p.grad._array if p.grad is not None else None
+                 for p in self._parameters]
+        if all(g is None for g in grads):
+            return
+        if self._state is None:
+            self._state = self.init_state(params)
+        self._step_count += 1
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        step = jnp.asarray(self._step_count, jnp.float32)
+
+        present_idx = [i for i, g in enumerate(grads) if g is not None]
+
+        if self._jitted is None:
+            def fused(grads_, params_, state_, lr_, step_):
+                grads_ = self._clip_grad_arrays(grads_)
+                return self.update(grads_, params_, state_, lr_, step_)
+            self._jitted = jax.jit(fused, donate_argnums=(1, 2))
+        new_params, new_state = self._jitted(grads, params, self._state,
+                                             lr, step)
+        self._state = new_state
+        for p, np_ in zip(self._parameters, new_params):
+            p._inplace_assign(np_)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameters:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # ----------------------------------------------------------- checkpoint
+    def state_dict(self):
+        out = {"step": self._step_count}
+        if self._state is not None:
+            for name, slots in zip(self._param_names, self._state):
+                for s, arr in slots.items():
+                    out[f"{name}/{s}"] = Tensor._from_array(arr)
+        from .lr import LRScheduler
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("step", 0))
+        if self._state is None:
+            self._state = self.init_state(
+                [p._array for p in self._parameters])
+        for i, (name, slots) in enumerate(
+                zip(self._param_names, self._state)):
+            for s in list(slots.keys()):
+                key = f"{name}/{s}"
+                if key in state:
+                    v = state[key]
+                    slots[s] = v._array if isinstance(v, Tensor) else \
+                        jnp.asarray(v)
+        from .lr import LRScheduler
+        if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state["LR_Scheduler"])
+
+
+def _decay_value(weight_decay):
+    if weight_decay is None:
+        return 0.0
+    coeff = getattr(weight_decay, "_coeff", None)  # L2Decay object
+    return float(coeff if coeff is not None else weight_decay)
+
+
+class L2Decay:
+    """paddle.regularizer.L2Decay"""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)

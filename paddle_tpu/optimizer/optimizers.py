@@ -1,0 +1,235 @@
+"""Concrete optimizers (reference: python/paddle/optimizer/{sgd,adam,...}.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    SLOTS = ()
+
+    def _rule(self, g, p, slots, lr, step):
+        return p - lr * g, slots
+
+
+class Momentum(Optimizer):
+    SLOTS = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         **kw)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _rule(self, g, p, slots, lr, step):
+        v = self._momentum * slots["velocity"] + g
+        if self._nesterov:
+            p2 = p - lr * (g + self._momentum * v)
+        else:
+            p2 = p - lr * v
+        slots["velocity"] = v
+        return p2, slots
+
+
+class Adagrad(Optimizer):
+    SLOTS = ("moment",)
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         **kw)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state_for(self, arr):
+        return {"moment": jnp.full_like(arr, self._init_acc,
+                                        dtype=jnp.float32)}
+
+    def _rule(self, g, p, slots, lr, step):
+        m = slots["moment"] + jnp.square(g)
+        slots["moment"] = m
+        return p - lr * g / (jnp.sqrt(m) + self._eps), slots
+
+
+class RMSProp(Optimizer):
+    SLOTS = ("mean_square", "moment")
+
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         **kw)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state_for(self, arr):
+        slots = {"mean_square": jnp.zeros_like(arr, dtype=jnp.float32),
+                 "moment": jnp.zeros_like(arr, dtype=jnp.float32)}
+        if self._centered:
+            slots["mean_grad"] = jnp.zeros_like(arr, dtype=jnp.float32)
+        return slots
+
+    def _rule(self, g, p, slots, lr, step):
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * jnp.square(g)
+        slots["mean_square"] = ms
+        denom = ms
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * g
+            slots["mean_grad"] = mg
+            denom = ms - jnp.square(mg)
+        mom = self._momentum * slots["moment"] + \
+            lr * g / jnp.sqrt(denom + self._eps)
+        slots["moment"] = mom
+        return p - mom, slots
+
+
+class Adadelta(Optimizer):
+    SLOTS = ("avg_squared_grad", "avg_squared_update")
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         **kw)
+        self._rho, self._eps = rho, epsilon
+
+    def _rule(self, g, p, slots, lr, step):
+        ag = self._rho * slots["avg_squared_grad"] + \
+            (1 - self._rho) * jnp.square(g)
+        upd = jnp.sqrt(slots["avg_squared_update"] + self._eps) / \
+            jnp.sqrt(ag + self._eps) * g
+        au = self._rho * slots["avg_squared_update"] + \
+            (1 - self._rho) * jnp.square(upd)
+        slots["avg_squared_grad"] = ag
+        slots["avg_squared_update"] = au
+        return p - lr * upd, slots
+
+
+class Adam(Optimizer):
+    SLOTS = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision=multi_precision, **kw)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _rule(self, g, p, slots, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - jnp.power(b1, step))
+        vhat = v / (1 - jnp.power(b2, step))
+        slots["moment1"], slots["moment2"] = m, v
+        return p - lr * mhat / (jnp.sqrt(vhat) + self._eps), slots
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+    _couple_decay = False
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 grad_clip=None, apply_decay_param_fun=None,
+                 multi_precision=False, lr_ratio=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip,
+                         apply_decay_param_fun=apply_decay_param_fun,
+                         multi_precision=multi_precision, **kw)
+
+
+class Lamb(Optimizer):
+    SLOTS = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip, **kw)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lamb_decay = lamb_weight_decay
+
+    def _rule(self, g, p, slots, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - jnp.power(b1, step))
+        vhat = v / (1 - jnp.power(b2, step))
+        r = mhat / (jnp.sqrt(vhat) + self._eps) + self._lamb_decay * p
+        w_norm = jnp.linalg.norm(p)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        slots["moment1"], slots["moment2"] = m, v
+        return p - lr * trust * r, slots
+
+
+class Adamax(Optimizer):
+    SLOTS = ("moment", "inf_norm")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         **kw)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _rule(self, g, p, slots, lr, step):
+        m = self._beta1 * slots["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * slots["inf_norm"], jnp.abs(g))
+        slots["moment"], slots["inf_norm"] = m, u
+        lr_t = lr / (1 - jnp.power(self._beta1, step))
+        return p - lr_t * m / (u + self._eps), slots
+
+
+class Adafactor(Optimizer):
+    """Factored second moments — the memory-efficient choice for large models
+    on TPU (state is O(n+m) instead of O(n*m))."""
+    SLOTS = ()
+
+    def __init__(self, learning_rate=0.001, beta1=None, decay_rate=0.8,
+                 epsilon1=1e-30, epsilon2=1e-3, clip_threshold=1.0,
+                 parameters=None, weight_decay=None, grad_clip=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         **kw)
+        self._beta1 = beta1
+        self._decay_rate = decay_rate
+        self._eps1, self._eps2 = epsilon1, epsilon2
+        self._clip_t = clip_threshold
+
+    def _init_state_for(self, arr):
+        slots = {}
+        if arr.ndim >= 2:
+            slots["vr"] = jnp.zeros(arr.shape[:-1], jnp.float32)
+            slots["vc"] = jnp.zeros(arr.shape[:-2] + arr.shape[-1:],
+                                    jnp.float32)
+        else:
+            slots["v"] = jnp.zeros_like(arr, dtype=jnp.float32)
+        if self._beta1 is not None:
+            slots["m"] = jnp.zeros_like(arr, dtype=jnp.float32)
+        return slots
+
+    def _rule(self, g, p, slots, lr, step):
+        rho = 1.0 - jnp.power(step, -self._decay_rate)
+        g2 = jnp.square(g) + self._eps1
+        if "vr" in slots:
+            vr = rho * slots["vr"] + (1 - rho) * g2.mean(axis=-1)
+            vc = rho * slots["vc"] + (1 - rho) * g2.mean(axis=-2)
+            slots["vr"], slots["vc"] = vr, vc
+            r = vr / jnp.clip(vr.mean(axis=-1, keepdims=True), 1e-30)
+            update = g / (jnp.sqrt(r)[..., None] * jnp.sqrt(vc)[..., None, :])
+        else:
+            v = rho * slots["v"] + (1 - rho) * g2
+            slots["v"] = v
+            update = g / jnp.sqrt(v)
+        rms = jnp.sqrt(jnp.mean(jnp.square(update)))
+        update = update / jnp.maximum(1.0, rms / self._clip_t)
+        if self._beta1 is not None:
+            m = self._beta1 * slots["m"] + (1 - self._beta1) * update
+            slots["m"] = m
+            update = m
+        scale = jnp.maximum(self._eps2, jnp.sqrt(jnp.mean(jnp.square(p))))
+        return p - lr * scale * update, slots

@@ -1,0 +1,82 @@
+"""Profiler / tracing subsystem (reference: python/paddle/profiler/).
+
+TPU-native: wraps jax.profiler (perfetto/xplane traces viewable in
+tensorboard or xprof) plus lightweight wall-clock step timers.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+class RecordEvent:
+    def __init__(self, name):
+        self.name = name
+        self._ctx = None
+
+    def __enter__(self):
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+        self.begin = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.end = time.perf_counter()
+        self._ctx.__exit__(*exc)
+        return False
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, log_dir="./profiler_log"):
+        self.log_dir = log_dir
+        self.timer_only = timer_only
+        self._step_times = []
+        self._t0 = None
+        self._started = False
+
+    def start(self):
+        if not self.timer_only:
+            jax.profiler.start_trace(self.log_dir)
+        self._started = True
+        self._t0 = time.perf_counter()
+
+    def step(self, num_samples=None):
+        t = time.perf_counter()
+        if self._t0 is not None:
+            self._step_times.append(t - self._t0)
+        self._t0 = t
+
+    def stop(self):
+        if self._started and not self.timer_only:
+            jax.profiler.stop_trace()
+        self._started = False
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        if not self._step_times:
+            return "no steps recorded"
+        times = self._step_times
+        avg = sum(times) / len(times)
+        return (f"steps={len(times)} avg={avg*1e3:.2f}ms "
+                f"min={min(times)*1e3:.2f}ms max={max(times)*1e3:.2f}ms")
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+@contextlib.contextmanager
+def profile(log_dir="./profiler_log"):
+    p = Profiler(log_dir=log_dir)
+    p.start()
+    try:
+        yield p
+    finally:
+        p.stop()

@@ -1,0 +1,526 @@
+"""Eager Tensor (reference: paddle.Tensor, paddle/fluid/eager/eager_tensor.h).
+
+Wraps one jax.Array plus autograd metadata.  All compute flows through the
+ops dispatch table so AMP + tape recording apply uniformly; on TPU every op
+is an XLA executable dispatched asynchronously (the reference's stream
+semantics come for free).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtypes
+from .autograd import engine
+from .device import current_place, CPUPlace, TPUPlace
+from .ops import dispatch as ops
+
+
+def _coerce(data, dtype=None):
+    if isinstance(data, Tensor):
+        arr = data._array
+        return arr.astype(dtypes.convert_dtype(dtype)) if dtype is not None else arr
+    d = dtypes.convert_dtype(dtype)
+    if isinstance(data, (jnp.ndarray, jax.Array)):
+        return data.astype(d) if d is not None and data.dtype != d else data
+    arr = np.asarray(data)
+    if d is None:
+        # python floats default to the framework default dtype (paddle semantics)
+        if arr.dtype == np.float64:
+            d = dtypes.get_default_dtype()
+        elif arr.dtype == np.int64:
+            d = dtypes.int64
+    return jnp.asarray(arr, dtype=d)
+
+
+class Tensor:
+    __slots__ = ("_array", "stop_gradient", "grad", "_node", "_out_index",
+                 "_retain_grads", "name", "persistable", "pspec", "__weakref__")
+
+    def __init__(self, data=None, dtype=None, place=None, stop_gradient=True,
+                 name=None):
+        self._array = _coerce(data, dtype) if data is not None else jnp.zeros(())
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self._out_index = 0
+        self._retain_grads = False
+        self.name = name
+        self.persistable = False
+        self.pspec = None  # PartitionSpec annotation for distributed runs
+
+    # ------------------------------------------------------------- wrapping
+    @classmethod
+    def _from_array(cls, array, stop_gradient=True, name=None):
+        t = cls.__new__(cls)
+        t._array = array
+        t.stop_gradient = stop_gradient
+        t.grad = None
+        t._node = None
+        t._out_index = 0
+        t._retain_grads = False
+        t.name = name
+        t.persistable = False
+        t.pspec = None
+        return t
+
+    # ----------------------------------------------------------- properties
+    @property
+    def shape(self):
+        return list(self._array.shape)
+
+    @property
+    def dtype(self):
+        return self._array.dtype
+
+    @property
+    def ndim(self):
+        return self._array.ndim
+
+    dim = property(lambda self: self._array.ndim)
+
+    @property
+    def size(self):
+        return int(self._array.size)
+
+    @property
+    def place(self):
+        try:
+            dev = list(self._array.devices())[0]
+            return CPUPlace(dev.id) if dev.platform == "cpu" else TPUPlace(dev.id)
+        except Exception:
+            return current_place()
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    @property
+    def T(self):
+        return ops.call("transpose", self, perm=list(range(self.ndim))[::-1])
+
+    @property
+    def mT(self):
+        return ops.call("swapaxes", self, a=-1, b=-2)
+
+    @property
+    def requires_grad(self):
+        return not self.stop_gradient
+
+    # ------------------------------------------------------------ conversion
+    def numpy(self):
+        return np.asarray(self._array)
+
+    def item(self):
+        return self._array.item()
+
+    def tolist(self):
+        return np.asarray(self._array).tolist()
+
+    def astype(self, dtype):
+        return ops.call("cast", self, dtype=dtypes.convert_dtype(dtype))
+
+    cast = astype
+
+    def clone(self):
+        return ops.call("add", self, Tensor._from_array(
+            jnp.zeros((), self._array.dtype)))
+
+    def detach(self):
+        return Tensor._from_array(self._array, stop_gradient=True,
+                                  name=self.name)
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def cpu(self):
+        return Tensor._from_array(
+            jax.device_put(self._array, jax.devices("cpu")[0]),
+            stop_gradient=self.stop_gradient)
+
+    def to(self, *args, **kwargs):
+        dtype = kwargs.get("dtype")
+        device = kwargs.get("device")
+        for a in args:
+            if isinstance(a, str) and a in ("cpu", "tpu", "gpu"):
+                device = a
+            else:
+                dtype = a
+        out = self
+        if dtype is not None:
+            out = out.astype(dtype)
+        if device is not None:
+            place = CPUPlace(0) if device == "cpu" else TPUPlace(0)
+            out = Tensor._from_array(
+                jax.device_put(out._array, place.jax_device()),
+                stop_gradient=out.stop_gradient)
+        return out
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    # -------------------------------------------------------------- autograd
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from .autograd.functional import backward
+        g = grad_tensor._array if isinstance(grad_tensor, Tensor) else grad_tensor
+        backward([self], [g] if g is not None else None,
+                 retain_graph=retain_graph)
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def zero_(self):
+        self._array = jnp.zeros_like(self._array)
+        return self
+
+    def fill_(self, value):
+        self._array = jnp.full_like(self._array, value)
+        return self
+
+    def set_value(self, value):
+        arr = _coerce(value)
+        if tuple(arr.shape) != tuple(self._array.shape):
+            raise ValueError(
+                f"set_value shape mismatch {arr.shape} vs {self._array.shape}")
+        self._array = arr.astype(self._array.dtype)
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    def _inplace_assign(self, array):
+        """Raw in-place buffer swap (optimizers, initializers)."""
+        self._array = array
+        return self
+
+    # ------------------------------------------------------------- operators
+    def _b(self, name, other, reverse=False):
+        o = other if isinstance(other, Tensor) else Tensor._from_array(
+            _coerce_scalar(other, self._array.dtype))
+        a, b = (o, self) if reverse else (self, o)
+        return ops.call(name, a, b)
+
+    def __add__(self, o): return self._b("add", o)
+    def __radd__(self, o): return self._b("add", o, True)
+    def __sub__(self, o): return self._b("subtract", o)
+    def __rsub__(self, o): return self._b("subtract", o, True)
+    def __mul__(self, o): return self._b("multiply", o)
+    def __rmul__(self, o): return self._b("multiply", o, True)
+    def __truediv__(self, o): return self._b("divide", o)
+    def __rtruediv__(self, o): return self._b("divide", o, True)
+    def __floordiv__(self, o): return self._b("floor_divide", o)
+    def __mod__(self, o): return self._b("mod", o)
+    def __pow__(self, o): return self._b("pow", o)
+    def __rpow__(self, o): return self._b("pow", o, True)
+    def __matmul__(self, o): return self._b("matmul", o)
+    def __neg__(self): return ops.call("neg", self)
+    def __abs__(self): return ops.call("abs", self)
+    def __eq__(self, o): return self._b("equal", o)
+    def __ne__(self, o): return self._b("not_equal", o)
+    def __lt__(self, o): return self._b("less_than", o)
+    def __le__(self, o): return self._b("less_equal", o)
+    def __gt__(self, o): return self._b("greater_than", o)
+    def __ge__(self, o): return self._b("greater_equal", o)
+    def __and__(self, o): return self._b("bitwise_and", o)
+    def __or__(self, o): return self._b("bitwise_or", o)
+    def __xor__(self, o): return self._b("bitwise_xor", o)
+    def __invert__(self): return ops.call("bitwise_not", self)
+
+    __hash__ = object.__hash__
+
+    def __getitem__(self, index):
+        index = _unwrap_index(index)
+        return ops.call("getitem", self, index=index)
+
+    def __setitem__(self, index, value):
+        if not self.stop_gradient and engine.grad_enabled() and \
+                self._node is not None:
+            raise RuntimeError(
+                "in-place __setitem__ on a non-leaf tensor that requires grad "
+                "would corrupt the autograd graph (reference raises the same "
+                "inplace-version error); use paddle_tpu.where / "
+                "tensor.put_along_axis instead")
+        index = _unwrap_index(index)
+        v = value if isinstance(value, Tensor) else Tensor._from_array(
+            _coerce_scalar(value, self._array.dtype))
+        out = ops.call("setitem_", self, v, index=index)
+        self._array = out._array
+        self._node = out._node
+        if out._node is not None:
+            self.stop_gradient = False
+            # re-point the node's weakref output at self
+            out._node.out_refs[out._out_index] = __import__("weakref").ref(self)
+            self._out_index = out._out_index
+        return self
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._array.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        return bool(self._array)
+
+    def __int__(self):
+        return int(self._array)
+
+    def __float__(self):
+        return float(self._array)
+
+    def __index__(self):
+        return int(self._array)
+
+    def __repr__(self):
+        grad_s = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}"
+                f"{grad_s},\n       {np.asarray(self._array)!r})")
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._array)
+        return a.astype(dtype) if dtype else a
+
+    # jax pytree-friendly: let jnp.asarray(tensor) work
+    def __jax_array__(self):
+        return self._array
+
+
+def _coerce_scalar(value, ref_dtype):
+    if isinstance(value, (bool, np.bool_)):
+        return jnp.asarray(value)
+    if isinstance(value, (int, float, np.number)):
+        if jnp.issubdtype(ref_dtype, jnp.inexact):
+            return jnp.asarray(value, ref_dtype)
+        if isinstance(value, int):
+            return jnp.asarray(value, ref_dtype)
+        return jnp.asarray(value, dtypes.get_default_dtype())
+    return _coerce(value)
+
+
+def _unwrap_index(index):
+    """Tensors inside an index become raw arrays (non-differentiable consts)."""
+    if isinstance(index, Tensor):
+        return index._array
+    if isinstance(index, tuple):
+        return tuple(_unwrap_index(i) for i in index)
+    if isinstance(index, list):
+        return [_unwrap_index(i) for i in index]
+    if isinstance(index, slice):
+        return slice(_unwrap_index(index.start), _unwrap_index(index.stop),
+                     _unwrap_index(index.step))
+    return index
+
+
+def _wrap_out(out, stop_gradient=True):
+    if isinstance(out, tuple):
+        return tuple(Tensor._from_array(o, stop_gradient=stop_gradient)
+                     for o in out)
+    return Tensor._from_array(out, stop_gradient=stop_gradient)
+
+
+# ------------------------------------------------------- method generation
+def _make_unary(name):
+    def m(self):
+        return ops.call(name, self)
+    m.__name__ = name
+    return m
+
+
+for _n in ("exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
+           "abs", "sign", "floor", "ceil", "round", "trunc", "sin", "cos",
+           "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh", "erf",
+           "erfinv", "reciprocal", "square", "sigmoid", "isnan", "isinf",
+           "isfinite", "logical_not", "bitwise_not", "conj", "digamma",
+           "lgamma", "frac", "neg"):
+    setattr(Tensor, _n, _make_unary(_n))
+
+
+def _make_binary(name):
+    def m(self, y, *args, **kwargs):
+        y = y if isinstance(y, Tensor) else Tensor._from_array(
+            _coerce_scalar(y, self._array.dtype))
+        return ops.call(name, self, y, **kwargs)
+    m.__name__ = name
+    return m
+
+
+for _n in ("add", "subtract", "multiply", "divide", "floor_divide", "mod",
+           "remainder", "pow", "maximum", "minimum", "fmax", "fmin", "atan2",
+           "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+           "less_equal", "logical_and", "logical_or", "logical_xor",
+           "bitwise_and", "bitwise_or", "bitwise_xor", "dot", "inner",
+           "outer", "mm", "mv", "bmm", "kron"):
+    setattr(Tensor, _n, _make_binary(_n))
+
+
+def _make_reduce(name):
+    def m(self, axis=None, keepdim=False):
+        return ops.call(name, self, axis=_norm_axis(axis), keepdim=keepdim)
+    m.__name__ = name
+    return m
+
+
+def _norm_axis(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis
+
+
+for _n in ("sum", "mean", "prod", "max", "min", "amax", "amin", "all", "any",
+           "logsumexp", "count_nonzero", "median", "nanmean", "nansum"):
+    setattr(Tensor, _n, _make_reduce(_n))
+
+
+# explicit-signature methods
+def _method(name):
+    def deco(fn):
+        fn.__name__ = name
+        setattr(Tensor, name, fn)
+        return fn
+    return deco
+
+
+@_method("matmul")
+def _t_matmul(self, y, transpose_x=False, transpose_y=False):
+    y = y if isinstance(y, Tensor) else Tensor._from_array(_coerce(y))
+    return ops.call("matmul", self, y, transpose_x=transpose_x,
+                    transpose_y=transpose_y)
+
+
+@_method("reshape")
+def _t_reshape(self, shape):
+    if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+        shape = shape[0]
+    return ops.call("reshape", self, shape=tuple(int(s) for s in shape))
+
+
+@_method("reshape_")
+def _t_reshape_(self, shape):
+    out = self.reshape(shape)
+    self._array, self._node, self._out_index = \
+        out._array, out._node, out._out_index
+    return self
+
+
+@_method("transpose")
+def _t_transpose(self, perm):
+    return ops.call("transpose", self, perm=[int(p) for p in perm])
+
+
+@_method("t")
+def _t_t(self):
+    return self.T
+
+
+@_method("flatten")
+def _t_flatten(self, start_axis=0, stop_axis=-1):
+    return ops.call("flatten", self, start_axis=start_axis,
+                    stop_axis=stop_axis)
+
+
+@_method("squeeze")
+def _t_squeeze(self, axis=None):
+    return ops.call("squeeze", self, axis=_norm_axis(axis))
+
+
+@_method("unsqueeze")
+def _t_unsqueeze(self, axis):
+    return ops.call("unsqueeze", self, axis=axis)
+
+
+@_method("cast")
+def _t_cast(self, dtype):
+    return ops.call("cast", self, dtype=dtypes.convert_dtype(dtype))
+
+
+@_method("astype")
+def _t_astype(self, dtype):
+    return ops.call("cast", self, dtype=dtypes.convert_dtype(dtype))
+
+
+@_method("std")
+def _t_std(self, axis=None, unbiased=True, keepdim=False):
+    return ops.call("std", self, axis=_norm_axis(axis), unbiased=unbiased,
+                    keepdim=keepdim)
+
+
+@_method("var")
+def _t_var(self, axis=None, unbiased=True, keepdim=False):
+    return ops.call("var", self, axis=_norm_axis(axis), unbiased=unbiased,
+                    keepdim=keepdim)
+
+
+@_method("argmax")
+def _t_argmax(self, axis=None, keepdim=False, dtype="int64"):
+    return ops.call("argmax", self, axis=axis, keepdim=keepdim,
+                    dtype=dtypes.convert_dtype(dtype))
+
+
+@_method("argmin")
+def _t_argmin(self, axis=None, keepdim=False, dtype="int64"):
+    return ops.call("argmin", self, axis=axis, keepdim=keepdim,
+                    dtype=dtypes.convert_dtype(dtype))
+
+
+@_method("clip")
+def _t_clip(self, min=None, max=None):
+    return ops.call("clip", self, min=min, max=max)
+
+
+@_method("norm")
+def _t_norm(self, p=2.0, axis=None, keepdim=False):
+    return ops.call("p_norm", self, p=float(p) if p not in ("fro",) else 2.0,
+                    axis=_norm_axis(axis), keepdim=keepdim)
+
+
+for _n in ("cumsum", "gather", "scatter", "sort", "argsort", "topk", "tile",
+           "expand", "broadcast_to", "roll", "flip", "split", "chunk",
+           "unbind", "tril", "triu", "where", "masked_fill", "index_select",
+           "take_along_axis", "put_along_axis", "repeat_interleave", "pad",
+           "softmax", "log_softmax", "unique", "nonzero", "masked_select",
+           "allclose", "isclose", "equal_all", "diagonal", "cumprod"):
+    # forwarded to the module-level functional API, defined in tensor_api
+    def _fwd(self, *args, _n=_n, **kwargs):
+        from . import tensor_api
+        return getattr(tensor_api, _n)(self, *args, **kwargs)
+    _fwd.__name__ = _n
+    setattr(Tensor, _n, _fwd)
+
+
+# in-place arithmetic used by optimizers / schedulers
+def _make_inplace(name, opname):
+    def m(self, y):
+        o = y if isinstance(y, Tensor) else Tensor._from_array(
+            _coerce_scalar(y, self._array.dtype))
+        with engine.no_grad():
+            self._array = ops.call_raw(opname, self._array, o._array)
+        return self
+    m.__name__ = name
+    return m
+
+
+for _n, _op in (("add_", "add"), ("subtract_", "subtract"),
+                ("multiply_", "multiply"), ("scale_", "multiply"),
+                ("divide_", "divide")):
+    setattr(Tensor, _n, _make_inplace(_n, _op))
+
+
+def parameter(data, dtype=None, name=None):
+    """Create a trainable parameter tensor (stop_gradient=False)."""
+    t = Tensor(data, dtype=dtype, stop_gradient=False, name=name)
+    t.persistable = True
+    return t

@@ -1,0 +1,613 @@
+"""Module-level tensor API (reference: python/paddle/tensor/*.py).
+
+Every function takes/returns eager Tensors and dispatches through the op
+registry so AMP + autograd apply.  Creation ops draw from the framework RNG
+(framework/random.py) so they are reproducible and trace-safe.
+"""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtypes
+from .device import current_place
+from .framework import random as _random
+from .ops import dispatch as ops
+from .tensor import Tensor, _coerce, _wrap_out
+
+
+def _t(x, ref=None):
+    if isinstance(x, Tensor):
+        return x
+    if ref is not None and isinstance(x, (int, float, bool, np.number)):
+        from .tensor import _coerce_scalar
+        return Tensor._from_array(_coerce_scalar(x, ref._array.dtype))
+    return Tensor._from_array(_coerce(x))
+
+
+# ------------------------------------------------------------------ creation
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def _dt(dtype):
+    return dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+
+
+def zeros(shape, dtype=None):
+    return Tensor._from_array(jnp.zeros(tuple(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None):
+    return Tensor._from_array(jnp.ones(tuple(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None):
+    if dtype is None and isinstance(fill_value, builtins.int):
+        dtype = dtypes.int64
+    return Tensor._from_array(jnp.full(tuple(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None):
+    return Tensor._from_array(jnp.zeros_like(_t(x)._array, dtype=dtypes.convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None):
+    return Tensor._from_array(jnp.ones_like(_t(x)._array, dtype=dtypes.convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None):
+    return Tensor._from_array(jnp.full_like(_t(x)._array, fill_value,
+                                            dtype=dtypes.convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    d = dtypes.convert_dtype(dtype)
+    if d is None:
+        if builtins.all(isinstance(v, builtins.int)
+                        for v in (start, end, step)):
+            d = dtypes.int64
+        else:
+            d = dtypes.get_default_dtype()
+    return Tensor._from_array(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None):
+    return Tensor._from_array(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return Tensor._from_array(jnp.logspace(start, stop, int(num), base=base,
+                                           dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return Tensor._from_array(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0):
+    return ops.call("diag", _t(x), offset=offset)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    return ops.call("diag_embed", _t(x), offset=offset, dim1=dim1, dim2=dim2)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return ops.call("diagonal", _t(x), offset=offset, axis1=axis1, axis2=axis2)
+
+
+def meshgrid(*args):
+    arrays = [_t(a)._array for a in args]
+    return [Tensor._from_array(a) for a in jnp.meshgrid(*arrays, indexing="ij")]
+
+
+def tril(x, diagonal=0):
+    return ops.call("tril", _t(x), diagonal=diagonal)
+
+
+def triu(x, diagonal=0):
+    return ops.call("triu", _t(x), diagonal=diagonal)
+
+
+def clone(x):
+    return _t(x).clone()
+
+
+def assign(x, output=None):
+    src = _t(x)
+    if output is None:
+        return Tensor._from_array(src._array)
+    output.set_value(src)
+    return output
+
+
+# -------------------------------------------------------------------- random
+def rand(shape, dtype=None):
+    return Tensor._from_array(jax.random.uniform(
+        _random.next_key(), tuple(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None):
+    return Tensor._from_array(jax.random.normal(
+        _random.next_key(), tuple(shape), _dt(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0):
+    return Tensor._from_array(jax.random.uniform(
+        _random.next_key(), tuple(shape), _dt(dtype), min, max))
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    if shape is None:
+        shape = ()
+    return Tensor._from_array(
+        jax.random.normal(_random.next_key(), tuple(shape),
+                          dtypes.get_default_dtype()) * std + mean)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None):
+    if high is None:
+        low, high = 0, low
+    d = dtypes.convert_dtype(dtype) or dtypes.int64
+    return Tensor._from_array(jax.random.randint(
+        _random.next_key(), tuple(shape), low, high, dtype=d))
+
+
+def randperm(n, dtype=None):
+    d = dtypes.convert_dtype(dtype) or dtypes.int64
+    return Tensor._from_array(
+        jax.random.permutation(_random.next_key(), n).astype(d))
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    logits = jnp.log(jnp.clip(_t(x)._array, 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(
+            _random.next_key(), logits, axis=-1,
+            shape=(num_samples,) + logits.shape[:-1]).T
+    else:
+        k = _random.next_key()
+        g = jax.random.gumbel(k, logits.shape)
+        out = jnp.argsort(-(logits + g), axis=-1)[..., :num_samples]
+    return Tensor._from_array(out.astype(jnp.int64))
+
+
+def bernoulli(x):
+    return Tensor._from_array(jax.random.bernoulli(
+        _random.next_key(), _t(x)._array).astype(_t(x)._array.dtype))
+
+
+def seed(s):
+    return _random.seed(s)
+
+
+# ------------------------------------------------------------- binary/math
+def _binop(name):
+    def f(x, y, name_arg=None):
+        xt = _t(x)
+        return xt._b(name, y)
+    f.__name__ = name
+    return f
+
+
+for _n in ("add", "subtract", "multiply", "divide", "floor_divide", "mod",
+           "remainder", "pow", "maximum", "minimum", "fmax", "fmin", "atan2",
+           "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+           "less_equal", "logical_and", "logical_or", "logical_xor",
+           "bitwise_and", "bitwise_or", "bitwise_xor", "heaviside",
+           "logaddexp", "hypot", "copysign", "nextafter"):
+    globals()[_n] = _binop(_n)
+
+
+def _unop(name):
+    def f(x, name_arg=None):
+        return ops.call(name, _t(x))
+    f.__name__ = name
+    return f
+
+
+for _n in ("exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
+           "abs", "sign", "floor", "ceil", "round", "trunc", "sin", "cos",
+           "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh", "asinh",
+           "acosh", "atanh", "erf", "erfinv", "reciprocal", "square",
+           "sigmoid", "isnan", "isinf", "isfinite", "logical_not",
+           "bitwise_not", "conj", "real", "imag", "digamma", "lgamma",
+           "frac", "neg", "i0"):
+    globals()[_n] = _unop(_n)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return ops.call("matmul", _t(x), _t(y), transpose_x=transpose_x,
+                    transpose_y=transpose_y)
+
+
+def mm(x, y):
+    return ops.call("mm", _t(x), _t(y))
+
+
+def bmm(x, y):
+    return ops.call("bmm", _t(x), _t(y))
+
+
+def dot(x, y):
+    return ops.call("dot", _t(x), _t(y))
+
+
+def cross(x, y, axis=-1):
+    return ops.call("cross", _t(x), _t(y), axis=axis)
+
+
+def outer(x, y):
+    return ops.call("outer", _t(x), _t(y))
+
+
+def einsum(equation, *operands):
+    return ops.call("einsum", *[_t(o) for o in operands], equation=equation)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return ops.call("addmm", _t(input), _t(x), _t(y), beta=beta, alpha=alpha)
+
+
+def lerp(x, y, weight):
+    return ops.call("lerp", _t(x), _t(y), _t(weight, ref=_t(x)))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, name=None):
+    return ops.call("scale", _t(x), scale=scale, bias=bias,
+                    bias_after_scale=bias_after_scale)
+
+
+def clip(x, min=None, max=None):
+    mn = float(min) if isinstance(min, (builtins.int, builtins.float)) else \
+        (min._array if isinstance(min, Tensor) else min)
+    mx = float(max) if isinstance(max, (builtins.int, builtins.float)) else \
+        (max._array if isinstance(max, Tensor) else max)
+    return ops.call("clip", _t(x), min=mn, max=mx)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return ops.call("nan_to_num", _t(x), nan=nan, posinf=posinf, neginf=neginf)
+
+
+def cast(x, dtype):
+    return _t(x).cast(dtype)
+
+
+# --------------------------------------------------------------- reductions
+def _redop(name):
+    def f(x, axis=None, keepdim=False, name_arg=None):
+        if isinstance(axis, (list, tuple)):
+            axis = tuple(builtins.int(a) for a in axis)
+        return ops.call(name, _t(x), axis=axis, keepdim=keepdim)
+    f.__name__ = name
+    return f
+
+
+for _n in ("sum", "mean", "prod", "max", "min", "amax", "amin", "all", "any",
+           "logsumexp", "count_nonzero", "median", "nanmean", "nansum"):
+    globals()[_n] = _redop(_n)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return ops.call("std", _t(x), axis=axis, unbiased=unbiased, keepdim=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return ops.call("var", _t(x), axis=axis, unbiased=unbiased, keepdim=keepdim)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    return ops.call("argmax", _t(x), axis=axis, keepdim=keepdim,
+                    dtype=dtypes.convert_dtype(dtype))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    return ops.call("argmin", _t(x), axis=axis, keepdim=keepdim,
+                    dtype=dtypes.convert_dtype(dtype))
+
+
+def cumsum(x, axis=None, dtype=None):
+    out = ops.call("cumsum", _t(x), axis=axis)
+    return out.cast(dtype) if dtype else out
+
+
+def cumprod(x, dim=None, dtype=None):
+    out = ops.call("cumprod", _t(x), dim=dim)
+    return out.cast(dtype) if dtype else out
+
+
+def logcumsumexp(x, axis=0):
+    return ops.call("logcumsumexp", _t(x), axis=axis)
+
+
+def norm(x, p=2.0, axis=None, keepdim=False):
+    if p == "fro":
+        p = 2.0
+    return ops.call("p_norm", _t(x), p=builtins.float(p), axis=axis,
+                    keepdim=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return ops.call("quantile", _t(x), q=q, axis=axis, keepdim=keepdim)
+
+
+# ------------------------------------------------------------- manipulation
+def reshape(x, shape):
+    return _t(x).reshape(shape)
+
+
+def transpose(x, perm):
+    return _t(x).transpose(perm)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    return _t(x).flatten(start_axis, stop_axis)
+
+
+def squeeze(x, axis=None):
+    return _t(x).squeeze(axis)
+
+
+def unsqueeze(x, axis):
+    return _t(x).unsqueeze(axis)
+
+
+def concat(x, axis=0):
+    return ops.call("concat", *[_t(v) for v in x], axis=builtins.int(axis))
+
+
+def stack(x, axis=0):
+    return ops.call("stack", *[_t(v) for v in x], axis=builtins.int(axis))
+
+
+def split(x, num_or_sections, axis=0):
+    return list(ops.call("split", _t(x), num_or_sections=num_or_sections,
+                         axis=builtins.int(axis)))
+
+
+def chunk(x, chunks, axis=0):
+    xt = _t(x)
+    n = xt.shape[builtins.int(axis)]
+    base = -(-n // chunks)
+    sections = [base] * (n // base) + ([n % base] if n % base else [])
+    return split(xt, sections, axis)
+
+
+def unbind(x, axis=0):
+    return list(ops.call("unbind", _t(x), axis=axis))
+
+
+def tile(x, repeat_times):
+    return ops.call("tile", _t(x), repeat_times=tuple(repeat_times))
+
+
+def expand(x, shape):
+    return ops.call("expand", _t(x), shape=tuple(shape))
+
+
+def expand_as(x, y):
+    return ops.call("broadcast_to", _t(x), shape=tuple(_t(y)._array.shape))
+
+
+def broadcast_to(x, shape):
+    return ops.call("broadcast_to", _t(x), shape=tuple(shape))
+
+
+def broadcast_tensors(inputs):
+    arrays = jnp.broadcast_arrays(*[_t(i)._array for i in inputs])
+    return [Tensor._from_array(a) for a in arrays]
+
+
+def roll(x, shifts, axis=None):
+    return ops.call("roll", _t(x), shifts=shifts, axis=axis)
+
+
+def flip(x, axis):
+    return ops.call("flip", _t(x), axis=axis)
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return ops.call("rot90", _t(x), k=k, axes=tuple(axes))
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return ops.call("repeat_interleave", _t(x), repeats=repeats, axis=axis)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    return ops.call("pad", _t(x), pad=list(pad), mode=mode, value=value,
+                    data_format=data_format)
+
+
+def gather(x, index, axis=0):
+    return ops.call("gather", _t(x), index=_t(index)._array, axis=axis)
+
+
+def gather_nd(x, index):
+    return ops.call("gather_nd", _t(x), index=_t(index)._array)
+
+
+def scatter(x, index, updates, overwrite=True):
+    return ops.call("scatter", _t(x), _t(updates),
+                    index=_t(index)._array, overwrite=overwrite)
+
+
+def scatter_nd_add(x, index, updates):
+    return ops.call("scatter_nd_add", _t(x), _t(updates),
+                    index=_t(index)._array)
+
+
+def index_select(x, index, axis=0):
+    return ops.call("index_select", _t(x), index=_t(index)._array, axis=axis)
+
+
+def index_add(x, index, axis, value):
+    return ops.call("index_add", _t(x), _t(value),
+                    index=_t(index)._array, axis=axis)
+
+
+def index_fill(x, index, axis, value):
+    return ops.call("index_fill", _t(x), index=_t(index)._array, axis=axis,
+                    value=value)
+
+
+def take_along_axis(x, indices, axis):
+    return ops.call("take_along_axis", _t(x), indices=_t(indices)._array,
+                    axis=axis)
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    return ops.call("put_along_axis", _t(x), _t(values, ref=_t(x)),
+                    indices=_t(indices)._array, axis=axis, reduce=reduce)
+
+
+def masked_fill(x, mask, value):
+    return ops.call("where", _t(mask).cast("bool"), _t(value, ref=_t(x)), _t(x))
+
+
+def masked_select(x, mask):
+    # dynamic output shape: eager-only (not jittable), like reference's op
+    xt = _t(x)
+    out = np.asarray(xt._array)[np.asarray(_t(mask)._array).astype(bool)]
+    return Tensor._from_array(jnp.asarray(out))
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return ops.call("where", _t(condition), _t(x, ref=None), _t(y, ref=None))
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(_t(x)._array)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor._from_array(jnp.asarray(i)) for i in nz)
+    return Tensor._from_array(jnp.asarray(np.stack(nz, axis=1)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None):
+    arr = np.asarray(_t(x)._array)
+    res = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor._from_array(jnp.asarray(res))
+    return tuple(Tensor._from_array(jnp.asarray(r)) for r in res)
+
+
+def sort(x, axis=-1, descending=False):
+    return ops.call("sort", _t(x), axis=axis, descending=descending)
+
+
+def argsort(x, axis=-1, descending=False):
+    return ops.call("argsort", _t(x), axis=axis, descending=descending)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    return ops.call("topk", _t(x), k=builtins.int(k), axis=axis,
+                    largest=largest, sorted=sorted)
+
+
+def searchsorted(sorted_sequence, values, right=False):
+    return ops.call("searchsorted", _t(sorted_sequence),
+                    v=_t(values)._array, right=right)
+
+
+def bincount(x, weights=None, minlength=0):
+    arr = _t(x)._array
+    return Tensor._from_array(jnp.bincount(
+        arr, weights=None if weights is None else _t(weights)._array,
+        minlength=minlength))
+
+
+def one_hot(x, num_classes):
+    return ops.call("one_hot", _t(x), num_classes=builtins.int(num_classes))
+
+
+def histogram(x, bins=100, min=0, max=0):
+    arr = np.asarray(_t(x)._array)
+    if min == 0 and max == 0:
+        min, max = arr.min(), arr.max()
+    h, _ = np.histogram(arr, bins=bins, range=(min, max))
+    return Tensor._from_array(jnp.asarray(h))
+
+
+# -------------------------------------------------------------- comparisons
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return Tensor._from_array(jnp.allclose(
+        _t(x)._array, _t(y)._array, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return Tensor._from_array(jnp.isclose(
+        _t(x)._array, _t(y)._array, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def equal_all(x, y):
+    return Tensor._from_array(jnp.array_equal(_t(x)._array, _t(y)._array))
+
+
+# ------------------------------------------------------------------ numeric
+def numel(x):
+    return Tensor._from_array(jnp.asarray(_t(x)._array.size))
+
+
+def shape(x):
+    return Tensor._from_array(jnp.asarray(_t(x)._array.shape))
+
+
+def rank(x):
+    return Tensor._from_array(jnp.asarray(_t(x)._array.ndim))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def iinfo(dtype):
+    return dtypes.iinfo(dtype)
+
+
+def finfo(dtype):
+    return dtypes.finfo(dtype)
+
+
+def increment(x, value=1.0):
+    x._array = x._array + value
+    return x
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    xt = _t(x)
+    v = jnp.sort(xt._array, axis=axis)
+    i = jnp.argsort(xt._array, axis=axis)
+    sel = jnp.take(v, k - 1, axis=axis)
+    seli = jnp.take(i, k - 1, axis=axis)
+    if keepdim:
+        sel, seli = jnp.expand_dims(sel, axis), jnp.expand_dims(seli, axis)
+    return Tensor._from_array(sel), Tensor._from_array(seli)
+
+
+def mode(x, axis=-1, keepdim=False):
+    raise NotImplementedError("mode: not yet implemented")
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return ops.call("trace_op", _t(x), offset=offset, axis1=axis1, axis2=axis2)

@@ -1,0 +1,15 @@
+"""Model zoo: NLP families (reference analog: PaddleNLP transformers)."""
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTModel, GPTForCausalLM, GPTBlock, GPTAttention, GPTMLP,
+    GPTPretrainingCriterion, gpt_loss_fn,
+)
+from .bert import (  # noqa: F401
+    BertConfig, BertModel, BertForSequenceClassification, BertForPretraining,
+)
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaModel, LlamaForCausalLM, LlamaBlock,
+)
+from .ernie import (  # noqa: F401
+    ErnieConfig, ErnieModel, ErnieForSequenceClassification,
+)
+from .generation import generate  # noqa: F401

@@ -1,0 +1,68 @@
+"""ERNIE-3.0 style model (reference analog: PaddleNLP transformers/ernie —
+the dy2static + CINN fused-inference benchmark model).  Architecturally a
+BERT-family encoder with task-type embeddings; inference path is
+paddle_tpu.jit.to_static, which compiles the whole encoder into one fused
+XLA program (the CINN role)."""
+from __future__ import annotations
+
+from .. import nn
+from ..nn import functional as F
+from .bert import BertConfig, BertModel
+
+
+class ErnieConfig(BertConfig):
+    def __init__(self, task_type_vocab_size=3, use_task_id=True, **kw):
+        kw.setdefault("vocab_size", 40000)
+        super().__init__(**kw)
+        self.task_type_vocab_size = task_type_vocab_size
+        self.use_task_id = use_task_id
+
+
+class ErnieModel(nn.Layer):
+    def __init__(self, cfg: ErnieConfig = None, **kw):
+        super().__init__()
+        cfg = cfg or ErnieConfig(**kw)
+        self.cfg = cfg
+        self.bert = BertModel(cfg)
+        if cfg.use_task_id:
+            self.task_type_embeddings = nn.Embedding(
+                cfg.task_type_vocab_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        from .. import tensor_api as T
+        emb = self.bert.embeddings
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = T.arange(s, dtype="int64").unsqueeze(0)
+        if token_type_ids is None:
+            token_type_ids = T.zeros([b, s], dtype="int64")
+        x = (emb.word_embeddings(input_ids)
+             + emb.position_embeddings(position_ids)
+             + emb.token_type_embeddings(token_type_ids))
+        if self.cfg.use_task_id:
+            if task_type_ids is None:
+                task_type_ids = T.zeros([b, s], dtype="int64")
+            x = x + self.task_type_embeddings(task_type_ids)
+        x = emb.dropout(emb.layer_norm(x))
+        if attention_mask is not None:
+            am = (1.0 - attention_mask.astype(x.dtype)) * -1e4
+            attention_mask = am.unsqueeze(1).unsqueeze(1)
+        seq = self.bert.encoder(x, attention_mask)
+        pooled = F.tanh(self.bert.pooler(seq[:, 0]))
+        return seq, pooled
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, cfg: ErnieConfig = None, num_classes=2, **kw):
+        super().__init__()
+        self.ernie = ErnieModel(cfg, **kw)
+        c = self.ernie.cfg
+        self.dropout = nn.Dropout(c.hidden_dropout_prob)
+        self.classifier = nn.Linear(c.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                               attention_mask)
+        return self.classifier(self.dropout(pooled))

@@ -1,0 +1,64 @@
+"""Autoregressive decoding with KV cache (reference analog: PaddleNLP
+generation_utils).  Eager loop over jitted single-token steps; greedy,
+temperature sampling, top-k, top-p."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import random as _random
+from ..tensor import Tensor
+
+
+def _sample_next(logits, temperature, top_k, top_p, greedy):
+    if greedy:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k is not None and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(_random.next_key(), logits, axis=-1)
+
+
+def generate(model, input_ids, max_new_tokens=20, do_sample=False,
+             temperature=1.0, top_k=None, top_p=None, eos_token_id=None):
+    """Returns Tensor [b, prompt + new] of token ids."""
+    was_training = model.training
+    model.eval()
+    try:
+        from ..autograd import engine
+        with engine.no_grad():
+            b = input_ids.shape[0]
+            dtype = next(iter(model.parameters()))._array.dtype
+            caches = model.new_caches(b, dtype=dtype)
+            tokens = input_ids
+            logits = model(tokens, caches=caches)
+            next_tok = _sample_next(
+                logits._array[:, -1, :].astype(jnp.float32), temperature,
+                top_k, top_p, greedy=not do_sample)
+            out = [np.asarray(tokens._array), np.asarray(next_tok)[:, None]]
+            finished = np.zeros(b, bool)
+            for _ in range(max_new_tokens - 1):
+                if eos_token_id is not None:
+                    finished |= (out[-1][:, 0] == eos_token_id)
+                    if finished.all():
+                        break
+                cur = Tensor._from_array(
+                    jnp.asarray(out[-1], dtype=tokens._array.dtype))
+                logits = model(cur, caches=caches)
+                next_tok = _sample_next(
+                    logits._array[:, -1, :].astype(jnp.float32),
+                    temperature, top_k, top_p, greedy=not do_sample)
+                out.append(np.asarray(next_tok)[:, None])
+            return Tensor(np.concatenate(out, axis=1))
+    finally:
+        if was_training:
+            model.train()

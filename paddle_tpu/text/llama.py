@@ -1,0 +1,206 @@
+"""LLaMA family (reference analog: PaddleNLP transformers/llama — the
+hybrid-parallel mp+pp+sharding+recompute benchmark model).
+
+RoPE, RMSNorm, SwiGLU, GQA; tensor-parallel via PartitionSpec-annotated
+projections like GPT.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..autograd import engine
+from ..nn import functional as F
+from ..distributed import mesh as mesh_mod
+from ..distributed.parallel_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+)
+from ..distributed.recompute import recompute
+
+
+class LlamaConfig:
+    PRESETS = {
+        "llama-7b": dict(hidden_size=4096, num_layers=32, num_heads=32,
+                         intermediate_size=11008),
+        "llama-13b": dict(hidden_size=5120, num_layers=40, num_heads=40,
+                          intermediate_size=13824),
+        "llama-tiny": dict(hidden_size=256, num_layers=2, num_heads=4,
+                           intermediate_size=688),
+    }
+
+    def __init__(self, vocab_size=32000, hidden_size=4096, num_layers=32,
+                 num_heads=32, num_kv_heads=None, intermediate_size=11008,
+                 max_position_embeddings=4096, rms_norm_eps=1e-6,
+                 rope_theta=10000.0, initializer_range=0.02,
+                 use_recompute=False, sequence_parallel=False,
+                 tensor_parallel=None):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads or num_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.rms_norm_eps = rms_norm_eps
+        self.rope_theta = rope_theta
+        self.initializer_range = initializer_range
+        self.use_recompute = use_recompute
+        self.sequence_parallel = sequence_parallel
+        self.tensor_parallel = tensor_parallel if tensor_parallel is not None \
+            else mesh_mod.degree("mp") > 1
+
+    @classmethod
+    def from_preset(cls, name, **kw):
+        return cls(**{**cls.PRESETS[name], **kw})
+
+
+def _rope(q, k, positions, theta):
+    """Rotary embedding applied to [b, s, h, d] arrays (pure jax)."""
+    d = q.shape[-1]
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = positions[..., None].astype(jnp.float32) * inv  # [b?, s, d/2]
+    cos = jnp.cos(freqs)[:, :, None, :]
+    sin = jnp.sin(freqs)[:, :, None, :]
+
+    def rot(x):
+        x1, x2 = x[..., ::2], x[..., 1::2]
+        xr1 = x1 * cos - x2 * sin
+        xr2 = x2 * cos + x1 * sin
+        return jnp.stack([xr1, xr2], axis=-1).reshape(x.shape)
+
+    return rot(q), rot(k)
+
+
+def _tp_linear(cfg, in_f, out_f, column=True):
+    init = nn.initializer.Normal(0.0, cfg.initializer_range)
+    if cfg.tensor_parallel:
+        l = (ColumnParallelLinear if column else RowParallelLinear)(
+            in_f, out_f, has_bias=False)
+        init(l.weight)
+        return l
+    return nn.Linear(in_f, out_f, weight_attr=init, bias_attr=False)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        self.q_proj = _tp_linear(cfg, cfg.hidden_size,
+                                 cfg.num_heads * self.head_dim)
+        self.k_proj = _tp_linear(cfg, cfg.hidden_size,
+                                 cfg.num_kv_heads * self.head_dim)
+        self.v_proj = _tp_linear(cfg, cfg.hidden_size,
+                                 cfg.num_kv_heads * self.head_dim)
+        self.o_proj = _tp_linear(cfg, cfg.num_heads * self.head_dim,
+                                 cfg.hidden_size, column=False)
+
+    def forward(self, x, cache=None):
+        from .. import tensor_api as T
+        cfg = self.cfg
+        b, s, _ = x.shape
+        q = self.q_proj(x).reshape([b, s, cfg.num_heads, self.head_dim])
+        k = self.k_proj(x).reshape([b, s, cfg.num_kv_heads, self.head_dim])
+        v = self.v_proj(x).reshape([b, s, cfg.num_kv_heads, self.head_dim])
+
+        offset = 0
+        if cache is not None:
+            offset = cache["k"].shape[1]
+
+        def rope_fn(qa, ka, offset=offset, theta=cfg.rope_theta):
+            pos = (offset + jnp.arange(qa.shape[1]))[None, :]
+            return _rope(qa, ka, pos, theta)
+
+        q, k = engine.apply("rope", rope_fn, [q, k])
+
+        if cache is not None:
+            k = T.concat([cache["k"], k], axis=1)
+            v = T.concat([cache["v"], v], axis=1)
+            cache["k"], cache["v"] = k, v
+        rep = cfg.num_heads // cfg.num_kv_heads
+        if rep > 1:
+            k = k.repeat_interleave(rep, axis=2)
+            v = v.repeat_interleave(rep, axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=(cache is None or s > 1), dropout_p=0.0,
+            training=self.training)
+        return self.o_proj(out.reshape([b, s, -1]))
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.gate_proj = _tp_linear(cfg, cfg.hidden_size,
+                                    cfg.intermediate_size)
+        self.up_proj = _tp_linear(cfg, cfg.hidden_size, cfg.intermediate_size)
+        self.down_proj = _tp_linear(cfg, cfg.intermediate_size,
+                                    cfg.hidden_size, column=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaBlock(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                                   cfg.rms_norm_eps)
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, x, cache=None):
+        x = x + self.self_attn(self.input_layernorm(x), cache=cache)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        if cfg.tensor_parallel:
+            self.embed_tokens = VocabParallelEmbedding(
+                cfg.vocab_size, cfg.hidden_size)
+        else:
+            self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                             weight_attr=init)
+        self.layers = nn.LayerList(
+            [LlamaBlock(cfg) for _ in range(cfg.num_layers)])
+        self.norm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+
+    def forward(self, input_ids, caches=None):
+        x = self.embed_tokens(input_ids)
+        for i, block in enumerate(self.layers):
+            cache = caches[i] if caches is not None else None
+            if self.cfg.use_recompute and self.training and cache is None:
+                x = recompute(block, x)
+            else:
+                x = block(x, cache=cache)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.llama = LlamaModel(cfg)
+        self.lm_head = _tp_linear(cfg, cfg.hidden_size, cfg.vocab_size)
+
+    def forward(self, input_ids, caches=None):
+        x = self.llama(input_ids, caches)
+        return self.lm_head(x)
+
+    def new_caches(self, batch_size, dtype="float32"):
+        from .. import tensor_api as T
+        hd = self.cfg.hidden_size // self.cfg.num_heads
+        return [{"k": T.zeros([batch_size, 0, self.cfg.num_kv_heads, hd],
+                              dtype=dtype),
+                 "v": T.zeros([batch_size, 0, self.cfg.num_kv_heads, hd],
+                              dtype=dtype)}
+                for _ in range(self.cfg.num_layers)]
+
+    def generate(self, input_ids, max_new_tokens=20, **kw):
+        from .generation import generate
+        return generate(self, input_ids, max_new_tokens=max_new_tokens, **kw)

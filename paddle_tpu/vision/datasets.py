@@ -1,0 +1,49 @@
+"""Datasets (reference: python/paddle/vision/datasets/).
+
+Zero-egress environment: downloads are gated; FakeImageNet / random data
+cover the training-loop and benchmark paths.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class FakeData(Dataset):
+    """Synthetic image classification dataset (deterministic per index)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=1000,
+                 transform=None):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = rng.randint(0, self.num_classes)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+
+FakeImageNet = FakeData
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        raise NotImplementedError(
+            "dataset downloads are unavailable in this offline environment; "
+            "use vision.datasets.FakeData or point image_path at local files")
+
+
+Cifar10 = MNIST
+Cifar100 = MNIST
+Flowers = MNIST
+VOC2012 = MNIST

@@ -1,0 +1,27 @@
+"""Test env: CPU backend with 8 virtual devices (multi-chip sharding tests
+run on a virtual mesh, per the driver's dryrun contract).
+
+NOTE: the axon TPU plugin force-sets jax.config.jax_platforms at import time,
+so the env var alone is not enough — we must override through jax.config
+before any backend is touched.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu
+    paddle_tpu.seed(42)
+    yield

@@ -1,0 +1,124 @@
+"""AMP + IO subsystem tests (SURVEY §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+
+
+def test_autocast_o1_matmul_bf16():
+    x = pt.randn([4, 4])
+    y = pt.randn([4, 4])
+    with pt.amp.auto_cast(level="O1", dtype="bfloat16"):
+        out = x @ y
+    assert out.dtype == pt.bfloat16
+    # denied op stays fp32
+    with pt.amp.auto_cast(level="O1", dtype="bfloat16"):
+        s = nn.functional.softmax(x)
+    assert s.dtype == pt.float32
+
+
+def test_autocast_disabled():
+    x = pt.randn([4, 4])
+    with pt.amp.auto_cast(enable=False):
+        out = x @ x
+    assert out.dtype == pt.float32
+
+
+def test_grad_scaler_scales_and_steps():
+    x = pt.parameter([1.0])
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=[x])
+    scaler = pt.amp.GradScaler(init_loss_scaling=4.0)
+    loss = (x * 2.0).sum()
+    scaled = scaler.scale(loss)
+    assert float(scaled) == pytest.approx(float(loss) * 4.0)
+    scaled.backward()
+    scaler.step(opt)  # unscale: grad 8/4=2 → x = 1 - 0.2
+    np.testing.assert_allclose(x.numpy(), [0.8], rtol=1e-5)
+
+
+def test_grad_scaler_skips_on_inf():
+    x = pt.parameter([1.0])
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=[x])
+    scaler = pt.amp.GradScaler(init_loss_scaling=4.0)
+    x.grad = pt.to_tensor([float("inf")])
+    scaler.step(opt)
+    np.testing.assert_allclose(x.numpy(), [1.0])  # step skipped
+    assert scaler.get_loss_scaling() < 4.0  # scale shrank
+
+
+def test_amp_decorate_o2():
+    m = nn.Linear(4, 4)
+    m, _ = pt.amp.decorate(models=m, optimizers=pt.optimizer.SGD(
+        learning_rate=0.1, parameters=m.parameters()), dtype="bfloat16")
+    assert m.weight.dtype == pt.bfloat16
+
+
+def test_dataset_dataloader():
+    from paddle_tpu.io import TensorDataset, DataLoader
+    X = pt.randn([20, 4]); Y = pt.arange(20)
+    ds = TensorDataset([X, Y])
+    assert len(ds) == 20
+    dl = DataLoader(ds, batch_size=6, drop_last=False)
+    batches = list(dl)
+    assert len(batches) == 4
+    assert batches[0][0].shape == [6, 4]
+    assert batches[-1][0].shape == [2, 4]
+    dl2 = DataLoader(ds, batch_size=5, shuffle=True, drop_last=True,
+                     num_workers=2)
+    batches = list(dl2)
+    assert len(batches) == 4
+
+
+def test_random_split_subset():
+    from paddle_tpu.io import TensorDataset, random_split
+    ds = TensorDataset([pt.arange(10)])
+    a, b = random_split(ds, [7, 3])
+    assert len(a) == 7 and len(b) == 3
+
+
+def test_distributed_batch_sampler():
+    from paddle_tpu.io import DistributedBatchSampler, TensorDataset
+    ds = TensorDataset([pt.arange(16)])
+    s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=0)
+    s1 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert len(i0) == 8 and len(i1) == 8
+    assert not set(i0) & set(i1)
+
+
+def test_fake_data():
+    ds = pt.vision.datasets.FakeData(size=10, image_shape=(3, 8, 8),
+                                     num_classes=4)
+    img, label = ds[0]
+    assert img.shape == (3, 8, 8)
+    assert 0 <= int(label) < 4
+    img2, label2 = ds[0]
+    np.testing.assert_allclose(img, img2)  # deterministic per index
+
+
+def test_transforms():
+    from paddle_tpu.vision import transforms as T
+    img = (np.random.rand(32, 32, 3) * 255).astype(np.uint8)
+    t = T.Compose([T.Resize(16), T.CenterCrop(8), T.ToTensor()])
+    out = t(img)
+    assert out.shape == [3, 8, 8]
+    n = T.Normalize(mean=[0.5] * 3, std=[0.5] * 3)
+    assert n(out).shape == [3, 8, 8]
+
+
+def test_profiler_timer():
+    p = pt.profiler.Profiler(timer_only=True)
+    p.start()
+    for _ in range(3):
+        p.step()
+    p.stop()
+    assert "steps=3" in p.summary()
+
+
+def test_check_numerics_flag():
+    from paddle_tpu.framework import flags
+    flags.set_flags({"check_numerics": True})
+    assert flags.get_flags("check_numerics")
+    flags.set_flags({"check_numerics": False})

@@ -1,0 +1,161 @@
+"""Autograd engine tests (SURVEY §4: chain rule, accumulation, no_grad,
+PyLayer, higher-order)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.autograd import PyLayer
+
+
+def test_simple_backward():
+    x = pt.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_rule():
+    x = pt.to_tensor(2.0, stop_gradient=False)
+    y = pt.exp(pt.sin(x))
+    y.backward()
+    expect = np.exp(np.sin(2.0)) * np.cos(2.0)
+    np.testing.assert_allclose(float(x.grad), expect, rtol=1e-5)
+
+
+def test_grad_accumulation():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_matmul_grad():
+    a = pt.randn([3, 4]); a.stop_gradient = False
+    b = pt.randn([4, 5]); b.stop_gradient = False
+    (a @ b).sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(),
+                               np.asarray(b.numpy()).sum(1)[None, :].repeat(3, 0),
+                               rtol=1e-5)
+
+
+def test_no_grad():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    with pt.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    y2 = x * 2
+    assert not y2.stop_gradient
+
+
+def test_no_grad_decorator():
+    @pt.no_grad()
+    def f(t):
+        return t * 2
+
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    assert f(x).stop_gradient
+
+
+def test_paddle_grad_api():
+    x = pt.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (gx,) = pt.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [6.0])
+    assert x.grad is None  # paddle.grad must not touch .grad
+
+
+def test_grad_unused_input():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    z = pt.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        pt.grad(y, [z], retain_graph=True)
+    gs = pt.grad(y, [x, z], allow_unused=True)
+    assert gs[1] is None
+
+
+def test_retain_graph():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    y = (x * 3).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_backward_twice_without_retain_raises():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    y = (x * 3).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_detach():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    z = y.detach() * 3
+    assert z.stop_gradient
+
+
+def test_retain_grads_intermediate():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.retain_grads()
+    (y * 3).sum().backward()
+    np.testing.assert_allclose(y.grad.numpy(), [3.0])
+
+
+def test_multi_output_op_grad():
+    x = pt.to_tensor([[4.0, 1.0, 3.0]], stop_gradient=False)
+    v, i = pt.topk(x, 2)
+    v.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1.0, 0.0, 1.0]])
+
+
+def test_broadcast_grad():
+    x = pt.to_tensor([[1.0, 2.0]], stop_gradient=False)  # [1,2]
+    y = pt.to_tensor([[1.0], [2.0], [3.0]], stop_gradient=False)  # [3,1]
+    (x * y).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[6.0, 6.0]])
+    np.testing.assert_allclose(y.grad.numpy(), [[3.0], [3.0], [3.0]])
+
+
+def test_pylayer():
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_second_order_grad():
+    x = pt.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x  # x^3
+    (gx,) = pt.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [12.0])  # 3x^2
+    (ggx,) = pt.grad(gx, x)
+    np.testing.assert_allclose(ggx.numpy(), [12.0])  # 6x
+
+
+def test_indexing_grad():
+    x = pt.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+    y = x[0]
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 1], [0, 0]])
+
+
+def test_is_grad_enabled():
+    assert pt.is_grad_enabled()
+    with pt.no_grad():
+        assert not pt.is_grad_enabled()

@@ -1,0 +1,107 @@
+"""jit.to_static / fused train step tests (SURVEY §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_to_static_matches_eager():
+    m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    m.eval()
+    x = pt.randn([3, 4])
+    eager = m(x)
+    static = pt.jit.to_static(m)
+    out = static(x)
+    np.testing.assert_allclose(out.numpy(), eager.numpy(), rtol=1e-5)
+
+
+def test_to_static_backward():
+    m = nn.Linear(4, 2)
+    static = pt.jit.to_static(m)
+    x = pt.randn([3, 4])
+    loss = static(x).sum()
+    loss.backward()
+    assert m.weight.grad is not None
+    # parity with eager grads
+    wg = m.weight.grad.numpy().copy()
+    m.clear_gradients()
+    m(x).sum().backward()
+    np.testing.assert_allclose(wg, m.weight.grad.numpy(), rtol=1e-5)
+
+
+def test_to_static_buffer_update():
+    bn = nn.BatchNorm1D(4)
+    static = pt.jit.to_static(bn)
+    bn.train()
+    x = pt.randn([16, 4]) + 5.0
+    static(x)
+    assert bn._mean.numpy().mean() > 0.1  # running mean moved
+
+
+def test_to_static_function():
+    @pt.jit.to_static
+    def f(a, b):
+        return a * 2 + b
+
+    x, y = pt.ones([3]), pt.ones([3])
+    np.testing.assert_allclose(f(x, y).numpy(), [3, 3, 3])
+
+
+def test_train_step_matches_eager():
+    pt.seed(7)
+    m1 = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    m2.set_state_dict(m1.state_dict())
+    o1 = pt.optimizer.SGD(learning_rate=0.1, parameters=m1.parameters())
+    o2 = pt.optimizer.SGD(learning_rate=0.1, parameters=m2.parameters())
+
+    x = pt.randn([8, 4]); y = pt.randn([8, 1])
+
+    def loss_fn(model, xi, yi):
+        return F.mse_loss(model(xi), yi)
+
+    step = pt.jit.train_step(m1, loss_fn, o1, donate=False)
+    for _ in range(3):
+        fused_loss = step(x, y)
+        eager_loss = loss_fn(m2, x, y)
+        eager_loss.backward()
+        o2.step(); o2.clear_grad()
+        np.testing.assert_allclose(float(fused_loss), float(eager_loss),
+                                   rtol=1e-4)
+    for (n1, p1), (n2, p2) in zip(m1.named_parameters(),
+                                  m2.named_parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_save_load(tmp_path):
+    m = nn.Linear(4, 2)
+    path = str(tmp_path / "model.pdparams")
+    pt.jit.save(m.state_dict(), path)
+    sd = pt.jit.load(path)
+    m2 = nn.Linear(4, 2)
+    m2.set_state_dict(sd)
+    x = pt.randn([2, 4])
+    np.testing.assert_allclose(m(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_recompute_matches_plain():
+    from paddle_tpu.distributed import recompute
+    pt.seed(3)
+    block = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 8))
+    x = pt.randn([4, 8]); x.stop_gradient = False
+
+    out_plain = block(x)
+    out_plain.sum().backward()
+    gx_plain = x.grad.numpy().copy()
+    gw_plain = block[0].weight.grad.numpy().copy()
+
+    x.clear_grad(); block.clear_gradients()
+    out_rc = recompute(block, x)
+    np.testing.assert_allclose(out_rc.numpy(), out_plain.numpy(), rtol=1e-5)
+    out_rc.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), gx_plain, rtol=1e-5)
+    np.testing.assert_allclose(block[0].weight.grad.numpy(), gw_plain,
+                               rtol=1e-5)

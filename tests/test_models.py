@@ -1,0 +1,184 @@
+"""Model zoo tests (SURVEY §4): shapes + tiny overfit + generation."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_resnet18_forward():
+    m = pt.vision.models.resnet18(num_classes=10)
+    m.eval()
+    x = pt.randn([2, 3, 64, 64])
+    assert m(x).shape == [2, 10]
+
+
+def test_resnet50_forward():
+    m = pt.vision.models.resnet50(num_classes=10)
+    m.eval()
+    x = pt.randn([1, 3, 64, 64])
+    assert m(x).shape == [1, 10]
+
+
+def test_lenet():
+    m = pt.vision.models.LeNet()
+    assert m(pt.randn([2, 1, 28, 28])).shape == [2, 10]
+
+
+def test_mobilenet_v2():
+    m = pt.vision.models.mobilenet_v2(num_classes=10)
+    m.eval()
+    assert m(pt.randn([1, 3, 64, 64])).shape == [1, 10]
+
+
+def _tiny_gpt(**kw):
+    from paddle_tpu.text import GPTConfig, GPTForCausalLM
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                    max_position_embeddings=32, tensor_parallel=False, **kw)
+    return GPTForCausalLM(cfg)
+
+
+def test_gpt_forward():
+    m = _tiny_gpt()
+    ids = pt.randint(0, 64, [2, 16])
+    assert m(ids).shape == [2, 16, 64]
+
+
+def test_gpt_overfit():
+    pt.seed(0)
+    m = _tiny_gpt(hidden_dropout=0.0, attention_dropout=0.0)
+    opt = pt.optimizer.Adam(learning_rate=1e-2, parameters=m.parameters())
+    ids = pt.randint(0, 64, [1, 12])
+    labels = pt.randint(0, 64, [1, 12])
+    from paddle_tpu.text import gpt_loss_fn
+    step = pt.jit.train_step(m, gpt_loss_fn, opt)
+    losses = [float(step(ids, labels)) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+def test_gpt_recompute_matches():
+    pt.seed(0)
+    m1 = _tiny_gpt(hidden_dropout=0.0, attention_dropout=0.0)
+    pt.seed(0)
+    m2 = _tiny_gpt(hidden_dropout=0.0, attention_dropout=0.0,
+                   use_recompute=True)
+    m2.set_state_dict(m1.state_dict())
+    ids = pt.randint(0, 64, [1, 8])
+    l1 = F.cross_entropy(m1(ids), ids)
+    l2 = F.cross_entropy(m2(ids), ids)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    l1.backward(); l2.backward()
+    p1 = dict(m1.named_parameters())
+    p2 = dict(m2.named_parameters())
+    for n in p1:
+        np.testing.assert_allclose(p1[n].grad.numpy(), p2[n].grad.numpy(),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_gpt_generation():
+    m = _tiny_gpt()
+    ids = pt.randint(0, 64, [2, 4])
+    out = m.generate(ids, max_new_tokens=5)
+    assert out.shape == [2, 9]
+    out2 = m.generate(ids, max_new_tokens=5, do_sample=True, top_k=10,
+                      top_p=0.9, temperature=0.8)
+    assert out2.shape == [2, 9]
+
+
+def test_gpt_kv_cache_matches_full_forward():
+    m = _tiny_gpt(hidden_dropout=0.0, attention_dropout=0.0)
+    m.eval()
+    ids = pt.randint(0, 64, [1, 6])
+    full_logits = m(ids)
+    caches = m.new_caches(1)
+    with pt.no_grad():
+        l1 = m(ids[:, :4], caches=caches)
+        l2 = m(ids[:, 4:5], caches=caches)
+        l3 = m(ids[:, 5:6], caches=caches)
+    np.testing.assert_allclose(l3.numpy()[:, 0], full_logits.numpy()[:, 5],
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_bert_forward():
+    from paddle_tpu.text import BertConfig, BertModel, \
+        BertForSequenceClassification
+    cfg = BertConfig(vocab_size=100, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=64,
+                     max_position_embeddings=32)
+    m = BertModel(cfg)
+    ids = pt.randint(0, 100, [2, 10])
+    seq, pooled = m(ids)
+    assert seq.shape == [2, 10, 32]
+    assert pooled.shape == [2, 32]
+    clf = BertForSequenceClassification(cfg, num_classes=3)
+    assert clf(ids).shape == [2, 3]
+
+
+def test_bert_attention_mask():
+    from paddle_tpu.text import BertConfig, BertModel
+    cfg = BertConfig(vocab_size=100, hidden_size=32, num_hidden_layers=1,
+                     num_attention_heads=4, intermediate_size=64,
+                     max_position_embeddings=32, hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0)
+    m = BertModel(cfg)
+    m.eval()
+    ids = pt.randint(0, 100, [1, 8])
+    mask = pt.to_tensor([[1, 1, 1, 1, 1, 1, 0, 0]])
+    seq_m, _ = m(ids, attention_mask=mask)
+    assert seq_m.shape == [1, 8, 32]
+
+
+def test_llama_forward_and_gqa():
+    from paddle_tpu.text import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig.from_preset("llama-tiny", vocab_size=64,
+                                  num_kv_heads=2, tensor_parallel=False)
+    m = LlamaForCausalLM(cfg)
+    ids = pt.randint(0, 64, [2, 8])
+    assert m(ids).shape == [2, 8, 64]
+
+
+def test_llama_kv_cache():
+    from paddle_tpu.text import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig.from_preset("llama-tiny", vocab_size=64,
+                                  tensor_parallel=False)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    ids = pt.randint(0, 64, [1, 6])
+    full = m(ids)
+    caches = m.new_caches(1)
+    with pt.no_grad():
+        m(ids[:, :5], caches=caches)
+        last = m(ids[:, 5:6], caches=caches)
+    np.testing.assert_allclose(last.numpy()[:, 0], full.numpy()[:, 5],
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_ernie_forward():
+    from paddle_tpu.text import ErnieConfig, ErnieModel, \
+        ErnieForSequenceClassification
+    cfg = ErnieConfig(vocab_size=100, hidden_size=32, num_hidden_layers=2,
+                      num_attention_heads=4, intermediate_size=64,
+                      max_position_embeddings=32)
+    m = ErnieModel(cfg)
+    ids = pt.randint(0, 100, [2, 10])
+    seq, pooled = m(ids)
+    assert seq.shape == [2, 10, 32]
+    clf = ErnieForSequenceClassification(cfg, num_classes=2)
+    assert clf(ids).shape == [2, 2]
+
+
+def test_ernie_to_static_inference():
+    """The reference's ERNIE benchmark path: dy2static + fused graph."""
+    from paddle_tpu.text import ErnieConfig, ErnieForSequenceClassification
+    cfg = ErnieConfig(vocab_size=100, hidden_size=32, num_hidden_layers=2,
+                      num_attention_heads=4, intermediate_size=64,
+                      max_position_embeddings=32, hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    m = ErnieForSequenceClassification(cfg, num_classes=2)
+    m.eval()
+    ids = pt.randint(0, 100, [2, 10])
+    eager = m(ids)
+    static = pt.jit.to_static(m)
+    np.testing.assert_allclose(static(ids).numpy(), eager.numpy(),
+                               rtol=1e-4, atol=1e-5)

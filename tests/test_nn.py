@@ -1,0 +1,203 @@
+"""nn layers: shapes, train/eval, state_dict (SURVEY §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear():
+    l = nn.Linear(8, 4)
+    x = pt.randn([2, 8])
+    assert l(x).shape == [2, 4]
+    assert l.weight.shape == [8, 4]
+    assert not l.weight.stop_gradient
+
+
+def test_embedding():
+    e = nn.Embedding(10, 6, padding_idx=0)
+    ids = pt.to_tensor([[1, 2], [0, 3]])
+    out = e(ids)
+    assert out.shape == [2, 2, 6]
+    np.testing.assert_allclose(out.numpy()[1, 0], np.zeros(6))
+
+
+def test_conv2d():
+    c = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    x = pt.randn([2, 3, 16, 16])
+    assert c(x).shape == [2, 8, 8, 8]
+    g = nn.Conv2D(8, 8, 3, padding=1, groups=2)
+    assert g(c(x)).shape == [2, 8, 8, 8]
+
+
+def test_pooling():
+    x = pt.randn([2, 4, 8, 8])
+    assert nn.MaxPool2D(2)(x).shape == [2, 4, 4, 4]
+    assert nn.AvgPool2D(2)(x).shape == [2, 4, 4, 4]
+    assert nn.AdaptiveAvgPool2D((1, 1))(x).shape == [2, 4, 1, 1]
+    np.testing.assert_allclose(
+        nn.AdaptiveAvgPool2D((1, 1))(x).numpy()[:, :, 0, 0],
+        x.numpy().mean(axis=(2, 3)), rtol=1e-5)
+
+
+def test_layer_norm():
+    ln = nn.LayerNorm(16)
+    x = pt.randn([4, 16])
+    out = ln(x)
+    np.testing.assert_allclose(out.numpy().mean(-1), np.zeros(4), atol=1e-5)
+    np.testing.assert_allclose(out.numpy().std(-1), np.ones(4), atol=1e-2)
+
+
+def test_rms_norm():
+    rn = nn.RMSNorm(16)
+    x = pt.randn([4, 16])
+    out = rn(x)
+    rms = np.sqrt((out.numpy() ** 2).mean(-1))
+    np.testing.assert_allclose(rms, np.ones(4), atol=1e-2)
+
+
+def test_batch_norm_train_eval():
+    bn = nn.BatchNorm2D(4)
+    x = pt.randn([8, 4, 5, 5])
+    bn.train()
+    out = bn(x)
+    # running stats moved off init
+    assert abs(bn._mean.numpy()).max() > 0
+    bn.eval()
+    out_eval = bn(x)
+    assert out_eval.shape == out.shape
+
+
+def test_dropout_train_eval():
+    d = nn.Dropout(0.5)
+    x = pt.ones([1000])
+    d.train()
+    y = d(x)
+    assert (y.numpy() == 0).mean() > 0.3
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+
+def test_sequential_and_containers():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    assert m(pt.randn([3, 4])).shape == [3, 2]
+    assert len(m) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+    assert "a" in ld
+
+
+def test_state_dict_roundtrip():
+    m1 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = m1.state_dict()
+    assert "0.weight" in sd and "2.bias" in sd
+    m2.set_state_dict(sd)
+    x = pt.randn([2, 4])
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_named_parameters():
+    m = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+    names = [n for n, _ in m.named_parameters()]
+    assert set(names) == {"0.weight", "0.bias", "1.weight", "1.bias"}
+    assert len(m.parameters()) == 4
+
+
+def test_mha():
+    mha = nn.MultiHeadAttention(32, 4)
+    x = pt.randn([2, 10, 32])
+    assert mha(x).shape == [2, 10, 32]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(32, 4, 64)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = pt.randn([2, 6, 32])
+    assert enc(x).shape == [2, 6, 32]
+
+
+def test_lstm_gru():
+    lstm = nn.LSTM(8, 16, num_layers=2)
+    x = pt.randn([2, 5, 8])
+    out, (h, c) = lstm(x)
+    assert out.shape == [2, 5, 16]
+    assert h.shape == [2, 2, 16]
+    gru = nn.GRU(8, 16, direction="bidirectional")
+    out, h = gru(x)
+    assert out.shape == [2, 5, 32]
+
+
+def test_losses():
+    logits = pt.randn([4, 10]); logits.stop_gradient = False
+    labels = pt.to_tensor([1, 2, 3, 4])
+    loss = nn.CrossEntropyLoss()(logits, labels)
+    assert loss.shape == []
+    loss.backward()
+    assert logits.grad is not None
+    # vs manual log-softmax
+    lo = logits.numpy().astype(np.float64)
+    ls = lo - np.log(np.exp(lo).sum(-1, keepdims=True))
+    expect = -ls[np.arange(4), [1, 2, 3, 4]].mean()
+    np.testing.assert_allclose(float(loss), expect, rtol=1e-4)
+
+    assert float(nn.MSELoss()(pt.ones([3]), pt.zeros([3]))) == 1.0
+    assert float(nn.L1Loss()(pt.ones([3]) * 2, pt.zeros([3]))) == 2.0
+
+
+def test_cross_entropy_ignore_index():
+    logits = pt.randn([4, 10])
+    labels = pt.to_tensor([1, -100, 3, -100])
+    loss = F.cross_entropy(logits, labels, ignore_index=-100,
+                           reduction="none")
+    assert float(loss.numpy()[1]) == 0.0
+
+
+def test_activations():
+    x = pt.to_tensor([-1.0, 0.0, 1.0])
+    np.testing.assert_allclose(F.relu(x).numpy(), [0, 0, 1])
+    np.testing.assert_allclose(F.sigmoid(x).numpy(),
+                               1 / (1 + np.exp([1, 0, -1])), rtol=1e-5)
+    assert F.gelu(x).shape == [3]
+    assert F.softmax(x).numpy().sum() == pytest.approx(1.0, rel=1e-5)
+
+
+def test_clip_grad_global_norm():
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    p1 = pt.parameter([3.0, 4.0])
+    from paddle_tpu.tensor import Tensor
+    import jax.numpy as jnp
+    (clipped,) = clip._clip_arrays([jnp.asarray([3.0, 4.0])])
+    np.testing.assert_allclose(np.asarray(clipped), [0.6, 0.8], rtol=1e-5)
+
+
+def test_initializers():
+    from paddle_tpu.nn import initializer as I
+    t = pt.parameter(np.zeros((100, 50), np.float32))
+    I.XavierUniform()(t)
+    limit = np.sqrt(6.0 / 150)
+    assert abs(t.numpy()).max() <= limit + 1e-6
+    I.Constant(3.0)(t)
+    assert (t.numpy() == 3.0).all()
+    I.Normal(0, 0.02)(t)
+    assert abs(t.numpy().std() - 0.02) < 0.005
+
+
+def test_sdpa_causal():
+    q = pt.randn([1, 4, 2, 8])
+    k = pt.randn([1, 4, 2, 8])
+    v = pt.randn([1, 4, 2, 8])
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    assert out.shape == [1, 4, 2, 8]
+    # first position attends only to itself
+    from paddle_tpu.ops.dispatch import call_raw
+    import jax.numpy as jnp
+    full = call_raw("sdpa", q._array, k._array, v._array, None,
+                    is_causal=True)
+    np.testing.assert_allclose(np.asarray(full[:, 0]),
+                               np.asarray(v._array[:, 0]), rtol=1e-4,
+                               atol=1e-5)

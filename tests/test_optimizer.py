@@ -1,0 +1,151 @@
+"""Optimizer + LR scheduler tests (SURVEY §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.optimizer import lr as lr_mod
+
+
+def _quadratic_converges(opt_cls, lr=0.1, steps=120, tol=1e-2, **kw):
+    target = pt.to_tensor([3.0, -2.0])
+    x = pt.parameter([0.0, 0.0])
+    opt = opt_cls(learning_rate=lr, parameters=[x], **kw)
+    for _ in range(steps):
+        loss = ((x - target) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    np.testing.assert_allclose(x.numpy(), target.numpy(), atol=tol)
+
+
+def test_sgd():
+    _quadratic_converges(pt.optimizer.SGD, lr=0.1)
+
+
+def test_momentum():
+    _quadratic_converges(pt.optimizer.Momentum, lr=0.05)
+
+
+def test_adam():
+    _quadratic_converges(pt.optimizer.Adam, lr=0.2)
+
+
+def test_adamw():
+    _quadratic_converges(pt.optimizer.AdamW, lr=0.2, weight_decay=0.0)
+
+
+def test_rmsprop():
+    _quadratic_converges(pt.optimizer.RMSProp, lr=0.05)
+
+
+def test_adagrad():
+    _quadratic_converges(pt.optimizer.Adagrad, lr=0.5, tol=0.15)
+
+
+def test_lamb():
+    _quadratic_converges(pt.optimizer.Lamb, lr=0.05, tol=0.3)
+
+
+def test_adafactor():
+    _quadratic_converges(pt.optimizer.Adafactor, lr=0.5, tol=0.3)
+
+
+def test_adam_matches_reference_formula():
+    # one step of Adam from zero state: update = lr * g_hat / (sqrt(v_hat)+eps)
+    x = pt.parameter([1.0])
+    opt = pt.optimizer.Adam(learning_rate=0.1, parameters=[x])
+    (x * 2.0).sum().backward()  # grad = 2
+    opt.step()
+    g = 2.0
+    m = 0.1 * g
+    v = 0.001 * g * g
+    mhat = m / 0.1
+    vhat = v / 0.001
+    expect = 1.0 - 0.1 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(x.numpy(), [expect], rtol=1e-5)
+
+
+def test_adamw_decoupled_decay():
+    x = pt.parameter([1.0])
+    opt = pt.optimizer.AdamW(learning_rate=0.1, parameters=[x],
+                             weight_decay=0.5)
+    (x * 0.0).sum().backward()  # zero grad → pure decay
+    opt.step()
+    np.testing.assert_allclose(x.numpy(), [1.0 - 0.1 * 0.5 * 1.0], rtol=1e-5)
+
+
+def test_grad_clip_in_optimizer():
+    x = pt.parameter([10.0])
+    opt = pt.optimizer.SGD(learning_rate=1.0, parameters=[x],
+                           grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    (x * 10.0).sum().backward()  # grad = 10 → clipped to 1
+    opt.step()
+    np.testing.assert_allclose(x.numpy(), [9.0], rtol=1e-5)
+
+
+def test_optimizer_state_dict():
+    x = pt.parameter([1.0])
+    opt = pt.optimizer.Adam(learning_rate=0.1, parameters=[x])
+    (x * 2).sum().backward()
+    opt.step()
+    sd = opt.state_dict()
+    assert sd["step"] == 1
+    opt2 = pt.optimizer.Adam(learning_rate=0.1, parameters=[x])
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 1
+
+
+def test_lr_schedulers():
+    s = lr_mod.StepDecay(0.1, step_size=2, gamma=0.5)
+    lrs = []
+    for _ in range(5):
+        lrs.append(s())
+        s.step()
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    c = lr_mod.CosineAnnealingDecay(1.0, T_max=10)
+    assert c() == pytest.approx(1.0)
+    for _ in range(10):
+        c.step()
+    assert c() == pytest.approx(0.0, abs=1e-6)
+
+    w = lr_mod.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+    assert w() == pytest.approx(0.0)
+    for _ in range(10):
+        w.step()
+    assert w() == pytest.approx(0.1)
+
+    n = lr_mod.NoamDecay(d_model=512, warmup_steps=100)
+    vals = []
+    for _ in range(200):
+        n.step()
+        vals.append(n())
+    assert np.argmax(vals) == pytest.approx(99, abs=2)
+
+
+def test_scheduler_in_optimizer():
+    x = pt.parameter([1.0])
+    sched = lr_mod.StepDecay(0.1, step_size=1, gamma=0.1)
+    opt = pt.optimizer.SGD(learning_rate=sched, parameters=[x])
+    (x * 1.0).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(x.numpy(), [0.9], rtol=1e-5)
+    sched.step()
+    opt.clear_grad()
+    (x * 1.0).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(x.numpy(), [0.89], rtol=1e-4)
+
+
+def test_multi_precision_master_weights():
+    import jax.numpy as jnp
+    x = pt.parameter(np.ones(4, np.float32))
+    x._inplace_assign(x._array.astype(jnp.bfloat16))
+    opt = pt.optimizer.Adam(learning_rate=0.01, parameters=[x],
+                            multi_precision=True)
+    (x.astype("float32") * 2).sum().backward()
+    opt.step()
+    assert x.dtype == jnp.bfloat16
+    assert "master" in opt._state[0]
+    assert opt._state[0]["master"].dtype == jnp.float32

@@ -1,0 +1,95 @@
+"""Regression tests for code-review findings (round 1)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+
+
+def test_scatter_ops_run():
+    x = pt.zeros([4, 3])
+    idx = pt.to_tensor([0, 2])
+    upd = pt.ones([2, 3])
+    out = pt.scatter(x, idx, upd)
+    np.testing.assert_allclose(out.numpy()[0], np.ones(3))
+    np.testing.assert_allclose(out.numpy()[1], np.zeros(3))
+    out2 = pt.scatter(x, idx, upd, overwrite=False)
+    np.testing.assert_allclose(out2.numpy()[2], np.ones(3))
+
+    nd_idx = pt.to_tensor([[0], [1]])
+    out3 = pt.scatter_nd_add(pt.zeros([3, 2]), nd_idx, pt.ones([2, 2]))
+    np.testing.assert_allclose(out3.numpy().sum(), 4.0)
+
+    out4 = pt.index_add(pt.zeros([3, 2]), pt.to_tensor([1]), 0,
+                        pt.ones([1, 2]))
+    np.testing.assert_allclose(out4.numpy()[1], np.ones(2))
+
+    x5 = pt.zeros([2, 3])
+    out5 = pt.put_along_axis(x5, pt.to_tensor([[0], [2]]), 9.0, axis=1)
+    assert float(out5.numpy()[0, 0]) == 9.0
+    assert float(out5.numpy()[1, 2]) == 9.0
+
+
+def test_cross_entropy_mean_ignores_padded():
+    logits = pt.randn([4, 5])
+    labels = pt.to_tensor([1, 1, -100, -100])
+    full = F.cross_entropy(logits[:2], labels[:2])
+    padded = F.cross_entropy(logits, labels)
+    np.testing.assert_allclose(float(full), float(padded), rtol=1e-5)
+
+
+def test_grad_outputs_none_entry():
+    x = pt.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (gx,) = pt.grad([y], [x], grad_outputs=[None])
+    np.testing.assert_allclose(gx.numpy(), [6.0])
+
+
+def test_nll_loss_weight():
+    logp = F.log_softmax(pt.randn([4, 3]))
+    labels = pt.to_tensor([0, 1, 2, 0])
+    w = pt.to_tensor([10.0, 1.0, 1.0])
+    weighted = F.nll_loss(logp, labels, weight=w)
+    unweighted = F.nll_loss(logp, labels)
+    assert abs(float(weighted) - float(unweighted)) > 1e-6
+
+
+def test_pool_ceil_mode():
+    x = pt.randn([1, 1, 5, 5])
+    out = F.max_pool2d(x, 2, stride=2, ceil_mode=True)
+    assert out.shape == [1, 1, 3, 3]
+    out2 = F.max_pool2d(x, 2, stride=2, ceil_mode=False)
+    assert out2.shape == [1, 1, 2, 2]
+    a = F.avg_pool2d(x, 2, stride=2, ceil_mode=True)
+    assert a.shape == [1, 1, 3, 3]
+
+
+def test_conv2d_transpose_list_dilation():
+    x = pt.randn([1, 2, 5, 5])
+    w = pt.randn([2, 3, 3, 3])
+    out_int = F.conv2d_transpose(x, w, dilation=1)
+    out_list = F.conv2d_transpose(x, w, dilation=[1, 1])
+    assert out_int.shape == out_list.shape == [1, 3, 7, 7]
+    np.testing.assert_allclose(out_int.numpy(), out_list.numpy(), rtol=1e-5)
+
+
+def test_interpolate_align_corners():
+    x = pt.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    out = F.interpolate(x, size=(7, 7), mode="bilinear", align_corners=True)
+    # corners must match exactly under align_corners
+    assert float(out.numpy()[0, 0, 0, 0]) == 0.0
+    assert float(out.numpy()[0, 0, -1, -1]) == 15.0
+    out_hp = F.interpolate(x, size=(7, 7), mode="bilinear",
+                           align_corners=False)
+    assert not np.allclose(out.numpy(), out_hp.numpy())
+
+
+def test_dropout_downscale_in_infer():
+    x = pt.ones([100])
+    out_infer = F.dropout(x, p=0.5, training=False,
+                          mode="downscale_in_infer")
+    np.testing.assert_allclose(out_infer.numpy(), np.full(100, 0.5))
+    out_train = F.dropout(x, p=0.5, training=True,
+                          mode="downscale_in_infer")
+    kept = out_train.numpy()[out_train.numpy() != 0]
+    np.testing.assert_allclose(kept, np.ones_like(kept))  # no upscale

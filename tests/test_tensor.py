@@ -1,0 +1,163 @@
+"""Tensor ops numeric parity vs numpy (SURVEY §4: per-op tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def np_t(x):
+    return np.asarray(x.numpy())
+
+
+def test_creation():
+    assert pt.zeros([2, 3]).shape == [2, 3]
+    assert pt.ones([4]).numpy().sum() == 4
+    assert pt.full([2, 2], 7).numpy()[0, 0] == 7
+    assert pt.arange(5).tolist() == [0, 1, 2, 3, 4]
+    assert pt.eye(3).numpy().trace() == 3
+    assert pt.linspace(0, 1, 5).shape == [5]
+    t = pt.to_tensor([[1.0, 2.0]])
+    assert t.dtype == pt.float32
+
+
+def test_binary_math():
+    a = pt.to_tensor([1.0, 2.0, 3.0])
+    b = pt.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose(np_t(a + b), [5, 7, 9])
+    np.testing.assert_allclose(np_t(a * b), [4, 10, 18])
+    np.testing.assert_allclose(np_t(b / a), [4, 2.5, 2])
+    np.testing.assert_allclose(np_t(a - 1), [0, 1, 2])
+    np.testing.assert_allclose(np_t(2 ** a), [2, 4, 8])
+    np.testing.assert_allclose(np_t(pt.maximum(a, b)), [4, 5, 6])
+
+
+def test_matmul_shapes():
+    x = pt.randn([4, 8])
+    y = pt.randn([8, 3])
+    assert (x @ y).shape == [4, 3]
+    assert pt.matmul(x, y).shape == [4, 3]
+    assert pt.matmul(y, x, transpose_x=True, transpose_y=True).shape == [3, 4]
+    b1 = pt.randn([2, 4, 8])
+    b2 = pt.randn([2, 8, 5])
+    assert pt.bmm(b1, b2).shape == [2, 4, 5]
+
+
+def test_reductions():
+    x = pt.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert float(x.sum()) == 66
+    np.testing.assert_allclose(np_t(x.sum(axis=0)), [12, 15, 18, 21])
+    np.testing.assert_allclose(np_t(x.mean(axis=1)),
+                               np.arange(12.).reshape(3, 4).mean(1))
+    assert float(x.max()) == 11
+    assert float(x.min()) == 0
+    assert x.sum(axis=1, keepdim=True).shape == [3, 1]
+    assert int(x.argmax()) == 11
+    np.testing.assert_allclose(np_t(x.std()),
+                               np.arange(12.).std(ddof=1), rtol=1e-6)
+
+
+def test_manipulation():
+    x = pt.arange(24, dtype="float32").reshape([2, 3, 4])
+    assert x.transpose([2, 0, 1]).shape == [4, 2, 3]
+    assert x.flatten().shape == [24]
+    assert x.flatten(1).shape == [2, 12]
+    assert x.unsqueeze(0).shape == [1, 2, 3, 4]
+    assert x.squeeze(None).shape == [2, 3, 4]
+    parts = pt.split(x, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    parts = pt.split(x, [1, -1], axis=2)
+    assert parts[1].shape == [2, 3, 3]
+    c = pt.concat([x, x], axis=0)
+    assert c.shape == [4, 3, 4]
+    s = pt.stack([x, x], axis=0)
+    assert s.shape == [2, 2, 3, 4]
+    assert pt.tile(pt.ones([2]), [3]).shape == [6]
+    assert pt.flip(pt.arange(3), axis=0).tolist() == [2, 1, 0]
+
+
+def test_indexing():
+    x = pt.arange(12, dtype="float32").reshape([3, 4])
+    assert float(x[1, 2]) == 6
+    assert x[0].shape == [4]
+    assert x[:, 1:3].shape == [3, 2]
+    idx = pt.to_tensor([0, 2])
+    assert pt.index_select(x, idx, axis=0).shape == [2, 4]
+    assert pt.gather(x, idx, axis=1).shape == [3, 2]
+    y = pt.zeros([3, 3])
+    y[1, 1] = 5.0
+    assert float(y[1, 1]) == 5.0
+
+
+def test_comparison_and_logic():
+    a = pt.to_tensor([1.0, 2.0, 3.0])
+    b = pt.to_tensor([3.0, 2.0, 1.0])
+    assert np_t(a == b).tolist() == [False, True, False]
+    assert np_t(a < b).tolist() == [True, False, False]
+    assert bool(pt.allclose(a, a))
+    assert not bool(pt.allclose(a, b))
+    assert bool(pt.equal_all(a, a))
+
+
+def test_sort_topk():
+    x = pt.to_tensor([3.0, 1.0, 2.0])
+    assert np_t(pt.sort(x)).tolist() == [1, 2, 3]
+    assert np_t(pt.argsort(x)).tolist() == [1, 2, 0]
+    v, i = pt.topk(x, 2)
+    assert np_t(v).tolist() == [3, 2]
+    assert np_t(i).tolist() == [0, 2]
+
+
+def test_where_masking():
+    x = pt.to_tensor([1.0, -2.0, 3.0])
+    out = pt.where(x > 0, x, pt.zeros_like(x))
+    assert np_t(out).tolist() == [1, 0, 3]
+    mf = pt.masked_fill(x, x < 0, 0.0)
+    assert np_t(mf).tolist() == [1, 0, 3]
+
+
+def test_einsum():
+    a = pt.randn([3, 4])
+    b = pt.randn([4, 5])
+    out = pt.einsum("ij,jk->ik", a, b)
+    np.testing.assert_allclose(np_t(out), np_t(a) @ np_t(b), rtol=1e-5)
+
+
+def test_linalg():
+    a = np.array([[2.0, 0.0], [0.0, 4.0]], np.float32)
+    x = pt.to_tensor(a)
+    np.testing.assert_allclose(np_t(pt.linalg.inv(x)), np.linalg.inv(a),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(pt.linalg.det(x)), 8.0, rtol=1e-5)
+    q, r = pt.linalg.qr(x)
+    np.testing.assert_allclose(np_t(q.matmul(r)), a, atol=1e-5)
+
+
+def test_dtype_cast():
+    x = pt.to_tensor([1.5, 2.5])
+    assert x.astype("int32").dtype == pt.int32
+    assert x.astype(pt.bfloat16).dtype == pt.bfloat16
+    assert pt.cast(x, "float16").dtype == pt.float16
+
+
+def test_cumsum_cumprod():
+    x = pt.to_tensor([1.0, 2.0, 3.0])
+    assert np_t(pt.cumsum(x, axis=0)).tolist() == [1, 3, 6]
+    assert np_t(pt.cumprod(x, dim=0)).tolist() == [1, 2, 6]
+
+
+def test_pad_roll():
+    x = pt.ones([2, 2])
+    # len(pad) == 2*ndim → per-dim [d0_lo, d0_hi, d1_lo, d1_hi]
+    p = pt.pad(x, [1, 1, 0, 0])
+    assert p.shape == [4, 2]
+    # shorter form pads trailing dims (reference/torch convention)
+    x3 = pt.ones([2, 3, 4])
+    assert pt.pad(x3, [1, 1]).shape == [2, 3, 6]
+    r = pt.roll(pt.arange(4), 1)
+    assert np_t(r).tolist() == [3, 0, 1, 2]
+
+
+def test_broadcast_expand():
+    x = pt.ones([1, 3])
+    assert pt.expand(x, [4, 3]).shape == [4, 3]
+    assert pt.broadcast_to(x, [2, 3]).shape == [2, 3]
