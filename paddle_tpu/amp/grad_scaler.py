@@ -24,6 +24,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = set()  # ids of optimizers already unscaled this step
 
     def is_enable(self):
         return self._enable
@@ -31,6 +32,9 @@ class GradScaler:
     def scale(self, loss):
         if not self._enable:
             return loss
+        # a new iteration starts here: forget last iteration's unscale marks
+        # (covers users who unscaled but never stepped, e.g. on exceptions)
+        self._unscaled.clear()
         return loss * self._scale
 
     def _grads_finite(self, optimizer):
@@ -41,19 +45,21 @@ class GradScaler:
         return True
 
     def unscale_(self, optimizer):
-        if not self._enable:
+        if not self._enable or id(optimizer) in self._unscaled:
             return
         self._found_inf = not self._grads_finite(optimizer)
         inv = 1.0 / self._scale
         for p in optimizer._parameters:
             if p.grad is not None:
                 p.grad._array = p.grad._array * inv
+        self._unscaled.add(id(optimizer))
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
+        self.unscale_(optimizer)  # no-op if the user already unscaled (clip)
+        self._unscaled.discard(id(optimizer))
         if not self._found_inf:
             optimizer.step()
         self._update_scale()
@@ -62,7 +68,7 @@ class GradScaler:
         self.step(optimizer)
 
     def update(self):
-        pass  # scale already updated in step()
+        self._unscaled.clear()  # scale itself already updated in step()
 
     def _update_scale(self):
         if not self._dynamic:

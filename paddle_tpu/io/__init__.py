@@ -271,6 +271,8 @@ class DataLoader:
                             for idxs in self.batch_sampler]
                         for f in futs:
                             q.put(f.result())
+            except BaseException as e:  # propagate to the consumer thread
+                q.put(e)
             finally:
                 q.put(sentinel)
 
@@ -280,4 +282,6 @@ class DataLoader:
             item = q.get()
             if item is sentinel:
                 break
+            if isinstance(item, BaseException):
+                raise item
             yield item

@@ -20,7 +20,8 @@ def silu(x): return ops.call("silu", _t(x))
 def swish(x): return ops.call("swish", _t(x))
 def mish(x): return ops.call("mish", _t(x))
 def hardswish(x): return ops.call("hardswish", _t(x))
-def hardsigmoid(x, slope=1/6, offset=0.5): return ops.call("hardsigmoid", _t(x))
+def hardsigmoid(x, slope=1/6, offset=0.5):
+    return ops.call("hardsigmoid", _t(x), slope=slope, offset=offset)
 def selu(x): return ops.call("selu", _t(x))
 def softsign(x): return ops.call("softsign", _t(x))
 def tanhshrink(x): return ops.call("tanhshrink", _t(x))
@@ -183,8 +184,15 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False):
-    return ops.call("max_pool2d", _t(x), kernel_size=kernel_size,
-                    stride=stride, padding=padding, ceil_mode=ceil_mode)
+    out = ops.call("max_pool2d", _t(x), kernel_size=kernel_size,
+                   stride=stride, padding=padding, ceil_mode=ceil_mode)
+    if not return_mask:
+        return out
+    from ..tensor import Tensor
+    mask = Tensor._from_array(ops.call_raw(
+        "max_pool2d_index", _t(x)._array, kernel_size=kernel_size,
+        stride=stride, padding=padding, ceil_mode=ceil_mode))
+    return out, mask
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,

@@ -11,10 +11,11 @@ class MaxPool2D(Layer):
         super().__init__()
         self.kernel_size, self.stride = kernel_size, stride
         self.padding, self.ceil_mode = padding, ceil_mode
+        self.return_mask = return_mask
 
     def forward(self, x):
         return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            self.ceil_mode)
+                            self.ceil_mode, return_mask=self.return_mask)
 
 
 class AvgPool2D(Layer):

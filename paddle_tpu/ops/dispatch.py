@@ -63,10 +63,15 @@ def _amp_cast(tensors, policy):
             cast_to = jnp.float32
         else:
             return tensors
+    cast_op = _REGISTRY["cast"]
     out = []
     for t in tensors:
         if jnp.issubdtype(t._array.dtype, jnp.floating) and t._array.dtype != cast_to:
-            out.append(t.cast(cast_to))
+            # apply the cast kernel directly (tape-recorded) rather than via
+            # call(): re-dispatching would amp-cast the 'cast' op's own input
+            # and recurse forever under O2.
+            out.append(engine.apply("cast", cast_op.fn, [t],
+                                    {"dtype": cast_to}))
         else:
             out.append(t)
     return out
@@ -75,7 +80,8 @@ def _amp_cast(tensors, policy):
 def call(name, *tensor_args, **consts):
     """Dispatch: amp-cast → autograd-recorded execution of the kernel."""
     op = _REGISTRY[name]
-    tensor_args = _amp_cast(list(tensor_args), op.amp)
+    if name != "cast":
+        tensor_args = _amp_cast(list(tensor_args), op.amp)
     return engine.apply(name, op.fn, tensor_args, consts)
 
 

@@ -33,7 +33,6 @@ _UNARY = {
     "silu": jax.nn.silu, "softplus_default": jax.nn.softplus,
     "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
     "hardswish": jax.nn.hard_swish,
-    "hardsigmoid": lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0),
     "isnan": jnp.isnan, "isinf": jnp.isinf, "isfinite": jnp.isfinite,
     "logical_not": jnp.logical_not, "bitwise_not": jnp.bitwise_not,
     "conj": jnp.conj, "real": jnp.real, "imag": jnp.imag,
@@ -44,6 +43,8 @@ _UNARY = {
 for _n, _f in _UNARY.items():
     register(_n, _f)
 
+register("hardsigmoid", lambda x, slope=1 / 6, offset=0.5: jnp.clip(
+    x * slope + offset, 0.0, 1.0))
 register("gelu", lambda x, approximate=False: jax.nn.gelu(
     x, approximate=bool(approximate)))
 register("leaky_relu", lambda x, negative_slope=0.01: jax.nn.leaky_relu(

@@ -124,6 +124,41 @@ def max_pool2d_k(x, kernel_size, stride=None, padding=0, ceil_mode=False):
         [(0, 0), (0, 0)] + list(p))
 
 
+@register("max_pool2d_index")
+def max_pool2d_index_k(x, kernel_size, stride=None, padding=0,
+                       ceil_mode=False):
+    """Argmax mask for max_pool2d: flat index into each (H, W) input map,
+    matching the reference's max_pool2d(..., return_mask=True) second output
+    (python/paddle/nn/functional/pooling.py)."""
+    k = _pair(kernel_size)
+    s = _pair(stride if stride is not None else kernel_size)
+    p = _conv_padding(padding, 2)
+    if isinstance(p, str):
+        raise ValueError("string padding unsupported for pool")
+    if ceil_mode:
+        p = [(p[i][0], p[i][1] + _ceil_extra(x.shape[2 + i], k[i], s[i],
+                                             p[i])) for i in range(2)]
+    H, W = x.shape[2], x.shape[3]
+    # -inf (not finfo.min) so padding never beats a real -inf input element,
+    # matching max_pool2d_k's reduce_window init value
+    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    xp = jnp.pad(x, [(0, 0), (0, 0)] + list(p), constant_values=neg)
+    # (N, C*kh*kw, Ho, Wo) patches, VALID since we padded by hand
+    patches = lax.conv_general_dilated_patches(
+        xp, filter_shape=k, window_strides=s, padding="VALID")
+    N, _, Ho, Wo = patches.shape
+    C = x.shape[1]
+    patches = patches.reshape(N, C, k[0] * k[1], Ho, Wo)
+    local = jnp.argmax(patches, axis=2)          # (N, C, Ho, Wo)
+    lh, lw = local // k[1], local % k[1]
+    oh = jnp.arange(Ho).reshape(1, 1, Ho, 1)
+    ow = jnp.arange(Wo).reshape(1, 1, 1, Wo)
+    gh = oh * s[0] - p[0][0] + lh
+    gw = ow * s[1] - p[1][0] + lw
+    return (gh * W + gw).astype(jnp.int32)
+
+
 @register("avg_pool2d")
 def avg_pool2d_k(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                  exclusive=True):

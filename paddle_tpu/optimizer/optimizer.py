@@ -134,8 +134,6 @@ class Optimizer:
         lr = jnp.asarray(self.get_lr(), jnp.float32)
         step = jnp.asarray(self._step_count, jnp.float32)
 
-        present_idx = [i for i, g in enumerate(grads) if g is not None]
-
         if self._jitted is None:
             def fused(grads_, params_, state_, lr_, step_):
                 grads_ = self._clip_grad_arrays(grads_)

@@ -93,3 +93,69 @@ def test_dropout_downscale_in_infer():
                           mode="downscale_in_infer")
     kept = out_train.numpy()[out_train.numpy() != 0]
     np.testing.assert_allclose(kept, np.ones_like(kept))  # no upscale
+
+
+def test_amp_o2_autocast_no_recursion():
+    import paddle_tpu as pt
+    x = pt.ones([4, 4], dtype="float32")
+    y = pt.ones([4, 4], dtype="float32")
+    with pt.amp.auto_cast(level="O2", dtype="bfloat16"):
+        z = x + y
+        w = z.matmul(y)
+    assert str(z.dtype).endswith("bfloat16")
+    assert str(w.dtype).endswith("bfloat16")
+
+
+def test_grad_scaler_unscale_then_step_single_unscale():
+    import paddle_tpu as pt
+    p = pt.create_parameter([1], "float32",
+                            default_initializer=pt.nn.initializer.Constant(1.0))
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    scaler = pt.amp.GradScaler(init_loss_scaling=1024.0)
+    loss = (p * 2.0).sum()
+    scaler.scale(loss).backward()
+    scaler.unscale_(opt)
+    scaler.step(opt)  # must NOT unscale a second time
+    # grad d(2p)/dp = 2 -> p = 1 - 0.1*2 = 0.8
+    np.testing.assert_allclose(p.numpy(), [0.8], rtol=1e-5)
+
+
+def test_dataloader_worker_exception_propagates():
+    import pytest
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class Bad(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            if i == 3:
+                raise ValueError("boom")
+            return np.zeros(2, np.float32)
+
+    dl = DataLoader(Bad(), batch_size=2, num_workers=2)
+    with pytest.raises(ValueError, match="boom"):
+        for _ in dl:
+            pass
+
+
+def test_max_pool2d_return_mask():
+    import paddle_tpu as pt
+    from paddle_tpu.nn import functional as F
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(2, 3, 4, 4).astype(np.float32)
+    x = pt.to_tensor(x_np)
+    out, mask = F.max_pool2d(x, kernel_size=2, return_mask=True)
+    assert out.shape == [2, 3, 2, 2] and mask.shape == [2, 3, 2, 2]
+    flat = x_np.reshape(2, 3, 16)
+    gathered = np.take_along_axis(flat, mask.numpy().reshape(2, 3, 4),
+                                  axis=2).reshape(2, 3, 2, 2)
+    np.testing.assert_allclose(out.numpy(), gathered)
+
+
+def test_hardsigmoid_slope_offset():
+    import paddle_tpu as pt
+    from paddle_tpu.nn import functional as F
+    x = pt.to_tensor(np.array([-1.0, 0.0, 1.0], np.float32))
+    out = F.hardsigmoid(x, slope=0.2, offset=0.5)
+    np.testing.assert_allclose(out.numpy(), [0.3, 0.5, 0.7], rtol=1e-6)
