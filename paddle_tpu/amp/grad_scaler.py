@@ -24,7 +24,12 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
-        self._unscaled = set()  # ids of optimizers already unscaled this step
+        # id(optimizer) -> found_inf for optimizers unscaled this iteration;
+        # per-optimizer so one optimizer's verdict can't mask another's
+        self._unscaled = {}
+        # OR of every optimizer's verdict this iteration: the scale update
+        # (like the reference's) is per iteration, not per optimizer
+        self._iter_found_inf = False
 
     def is_enable(self):
         return self._enable
@@ -35,6 +40,7 @@ class GradScaler:
         # a new iteration starts here: forget last iteration's unscale marks
         # (covers users who unscaled but never stepped, e.g. on exceptions)
         self._unscaled.clear()
+        self._iter_found_inf = False
         return loss * self._scale
 
     def _grads_finite(self, optimizer):
@@ -48,32 +54,39 @@ class GradScaler:
         if not self._enable or id(optimizer) in self._unscaled:
             return
         self._found_inf = not self._grads_finite(optimizer)
+        self._iter_found_inf = self._iter_found_inf or self._found_inf
         inv = 1.0 / self._scale
         for p in optimizer._parameters:
             if p.grad is not None:
                 p.grad._array = p.grad._array * inv
-        self._unscaled.add(id(optimizer))
+        self._unscaled[id(optimizer)] = self._found_inf
 
     def step(self, optimizer):
+        """Apply (or skip) this optimizer's step.  Like the reference, the
+        scale itself updates once per iteration in `update()`."""
         if not self._enable:
             optimizer.step()
             return
         self.unscale_(optimizer)  # no-op if the user already unscaled (clip)
-        self._unscaled.discard(id(optimizer))
+        self._found_inf = self._unscaled.pop(id(optimizer), self._found_inf)
         if not self._found_inf:
             optimizer.step()
-        self._update_scale()
 
     def minimize(self, optimizer, loss):
         self.step(optimizer)
+        self.update()
 
     def update(self):
-        self._unscaled.clear()  # scale itself already updated in step()
+        """Per-iteration dynamic-scale update from the OR of every stepped
+        optimizer's found_inf (reference: GradScaler.update)."""
+        self._unscaled.clear()
+        self._update_scale()
+        self._iter_found_inf = False
 
     def _update_scale(self):
         if not self._dynamic:
             return
-        if self._found_inf:
+        if self._iter_found_inf:
             self._bad_steps += 1
             self._good_steps = 0
             if self._bad_steps >= self._decr_every_n:

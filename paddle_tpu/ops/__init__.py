@@ -1,4 +1,5 @@
 from . import dispatch  # noqa: F401
 from . import kernels  # noqa: F401  (populates the registry)
 from . import nn_kernels  # noqa: F401
+from . import pallas  # noqa: F401  (overrides hot ops with TPU kernels)
 from .dispatch import register, override, call, call_raw  # noqa: F401

@@ -1,0 +1,47 @@
+"""Pallas TPU kernel overrides — the fused-GPU-kernel registry analog.
+
+Reference: paddle registers hand-fused CUDA kernels (flash_attn,
+fused_softmax_mask, ...) into PHI at build time; here pallas kernels
+override registry entries at import.  The override decides per call
+whether the pallas path applies (backend, shapes, mask) and otherwise
+falls through to the XLA implementation, so numerics are always defined.
+
+Env control: PADDLE_TPU_PALLAS=0 disables, =interpret forces the pallas
+kernels in interpreter mode (CPU tests).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ..dispatch import get, override
+from . import flash_attention as _fa
+
+
+def _mode():
+    env = os.environ.get("PADDLE_TPU_PALLAS", "").lower()
+    if env in ("0", "off", "false"):
+        return None
+    if env == "interpret":
+        return "interpret"
+    try:
+        backend = jax.default_backend()
+    except Exception:  # pragma: no cover
+        return None
+    return "tpu" if backend == "tpu" else None
+
+
+_xla_sdpa = get("sdpa").fn
+
+
+def sdpa_with_flash(q, k, v, mask=None, is_causal=False, scale=None):
+    mode = _mode()
+    if mode is not None and _fa.supports(q.shape, k.shape, mask, q.dtype,
+                                         v_shape=v.shape):
+        return _fa.flash_attention(q, k, v, is_causal=is_causal, scale=scale,
+                                   interpret=(mode == "interpret"))
+    return _xla_sdpa(q, k, v, mask=mask, is_causal=is_causal, scale=scale)
+
+
+override("sdpa", sdpa_with_flash)

@@ -1,0 +1,343 @@
+"""Pallas TPU flash attention (fwd + bwd), the fused-attention hot op.
+
+Reference parity: the reference exposes fused attention through
+`paddle.nn.functional.scaled_dot_product_attention` backed by a CUDA
+flash-attention kernel (paddle/phi/kernels/gpu/flash_attn_kernel.cu).
+Here the same op is a Pallas TPU kernel: blockwise online-softmax forward
+and a two-kernel backward (dK/dV sweep + dQ sweep), designed around the
+MXU (all matmuls are block matmuls with fp32 accumulation) and VMEM
+(running max / denominator / accumulator live in scratch across the
+innermost, sequential KV grid dimension).
+
+Layout is (batch, seq, heads, head_dim) to match `sdpa` in
+ops/nn_kernels.py; internally blocks run over a flattened (batch*heads)
+leading grid axis.  Falls back to the XLA `sdpa` path for shapes the
+kernel does not cover (ragged seq lens, explicit masks).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific pallas bits (absent on some CPU-only builds)
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+_NEG_INF = float("-inf")
+_LANES = 128  # TPU vector lane count; scratch minor dims sized to this
+
+
+# ------------------------------------------------------------------ forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
+                scale, causal, off, bq, bk, nk):
+    ik = pl.program_id(2)
+    iq = pl.program_id(1)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, _NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    q_start = iq * bq
+    k_start = ik * bk
+    # bottom-right-aligned causal (row r attends cols <= r + Lk - Lq),
+    # matching sdpa_k's jnp.tril(..., lk - lq)
+    run = (q_start + bq + off > k_start) if causal else (ik >= 0)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]                      # (bq, D) compute dtype
+        k = k_ref[0]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = q_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = k_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows + off >= cols, s, _NEG_INF)
+        m_prev = m_s[:, :1]               # (bq, 1) fp32
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        m_safe = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - m_safe)           # (bq, bk) fp32; masked cols -> 0
+        corr = jnp.exp(m_prev - m_safe)   # (bq, 1)
+        l_new = l_s[:, :1] * corr + p.sum(axis=-1, keepdims=True)
+        pv = lax.dot_general(p.astype(v_ref.dtype), v_ref[0],
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        acc_s[...] = acc_s[...] * corr + pv
+        m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        l = l_s[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_s[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = m_s[:, :1] + jnp.log(l_safe)
+
+
+def _compiler_params(semantics):
+    if pltpu is None:
+        return None
+    for name in ("CompilerParams", "TPUCompilerParams"):  # jax ≥0.9 / older
+        cls = getattr(pltpu, name, None)
+        if cls is not None:
+            try:
+                return cls(dimension_semantics=semantics)
+            except TypeError:  # pragma: no cover
+                continue
+    return None
+
+
+def _fwd(q, k, v, causal, scale, bq, bk, interpret):
+    BH, Lq, D = q.shape
+    Lk = k.shape[1]
+    nq, nk = Lq // bq, Lk // bk
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               off=Lk - Lq, bq=bq, bk=bk, nk=nk)
+    kwargs = {}
+    cp = _compiler_params(("parallel", "parallel", "arbitrary"))
+    if cp is not None and not interpret:
+        kwargs["compiler_params"] = cp
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            # lse is one scalar per row: keep it (BH, Lq, 1) so the block's
+            # trailing dims (bq, 1) satisfy mosaic's (8, 128)-or-full tiling
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Lq, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Lq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(q, k, v)
+
+
+# ----------------------------------------------------------------- backward
+def _bwd_p(q, k, lse, scale, causal, off, q_start, k_start, bq, bk):
+    """Recompute p = exp(s - lse) for one block of the backward sweeps."""
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        rows = q_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = k_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(rows + off >= cols, s, _NEG_INF)
+    lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+    return jnp.exp(s - lse_safe)          # masked / padded rows -> 0
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_s, dv_s, *, scale, causal, off, bq, bk,
+                nq):
+    iq = pl.program_id(2)
+    jk = pl.program_id(1)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
+
+    q_start = iq * bq
+    k_start = jk * bk
+    run = (q_start + bq + off > k_start) if causal else (iq >= 0)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                  # (bq, 1)
+        delta = delta_ref[0]
+        p = _bwd_p(q, k, lse, scale, causal, off, q_start, k_start, bq, bk)
+        dv_s[...] += lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_s[...] += lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(iq == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_s, *, scale, causal, off, bq, bk, nk):
+    jk = pl.program_id(2)
+    iq = pl.program_id(1)
+
+    @pl.when(jk == 0)
+    def _init():
+        dq_s[...] = jnp.zeros_like(dq_s)
+
+    q_start = iq * bq
+    k_start = jk * bk
+    run = (q_start + bq + off > k_start) if causal else (jk >= 0)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        p = _bwd_p(q, k, lse, scale, causal, off, q_start, k_start, bq, bk)
+        dp = lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_s[...] += lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(jk == nk - 1)
+    def _emit():
+        dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
+    BH, Lq, D = q.shape
+    Lk = k.shape[1]
+    nq, nk = Lq // bq, Lk // bk
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                    keepdims=True)        # (BH, Lq, 1), same layout as lse
+
+    q_spec = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))
+    row_spec = pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0))
+    kw = {}
+    cp = _compiler_params(("parallel", "parallel", "arbitrary"))
+    if cp is not None and not interpret:
+        kw["compiler_params"] = cp
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          off=Lk - Lq, bq=bq, bk=bk, nq=nq),
+        grid=(BH, nk, nq),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Lk, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Lk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        interpret=interpret,
+        **kw,
+    )(q, k, v, do, lse, delta)
+
+    q_spec2 = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
+    kv_spec2 = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))
+    row_spec2 = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          off=Lk - Lq, bq=bq, bk=bk, nk=nk),
+        grid=(BH, nq, nk),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Lq, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=interpret,
+        **kw,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# -------------------------------------------------------------- custom vjp
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, causal, scale, bq, bk, interpret):
+    o, _ = _fwd(q, k, v, causal, scale, bq, bk, interpret)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, bq, bk, interpret):
+    o, lse = _fwd(q, k, v, causal, scale, bq, bk, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(causal, scale, bq, bk, interpret, res, do):
+    q, k, v, o, lse = res
+    return _bwd(q, k, v, o, lse, do, causal, scale, bq, bk, interpret)
+
+
+_flash_core.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# ----------------------------------------------------------------- wrapper
+def flash_attention(q, k, v, is_causal=False, scale=None,
+                    block_q=512, block_k=512, interpret=False):
+    """Flash attention on (B, L, H, D) arrays; D padded to the lane width.
+
+    Requires seq lens divisible by the block sizes (caller checks via
+    `supports`).  Returns (B, Lq, H, D) in the input dtype.
+    """
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    scale = float(scale) if scale is not None else 1.0 / (D ** 0.5)
+    bq = min(block_q, Lq)
+    bk = min(block_k, Lk)
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    Dp = -(-D // _LANES) * _LANES
+    if Dp != D:
+        pad = [(0, 0), (0, 0), (0, Dp - D)]
+        qb, kb, vb = (jnp.pad(x, pad) for x in (qb, kb, vb))
+    o = _flash_core(qb, kb, vb, bool(is_causal), scale, bq, bk,
+                    bool(interpret))
+    if Dp != D:
+        o = o[..., :D]
+    return o.reshape(B, H, Lq, D).transpose(0, 2, 1, 3)
+
+
+def supports(q_shape, k_shape, mask, dtype, v_shape=None,
+             block_q=512, block_k=512):
+    """Shape/dtype gate for the pallas path; anything else → XLA sdpa."""
+    if pltpu is None:  # no TPU pallas support in this jax build
+        return False
+    if mask is not None or len(q_shape) != 4:
+        return False
+    if dtype not in (jnp.float32, jnp.bfloat16, jnp.float16):
+        return False
+    B, Lq, H, D = q_shape
+    Lk = k_shape[1]
+    if k_shape[2] != H:  # GQA repeat handled by callers before sdpa
+        return False
+    if k_shape[3] != D:
+        return False
+    if v_shape is not None and tuple(v_shape) != tuple(k_shape):
+        return False  # e.g. MLA-style distinct value head_dim → XLA path
+    bq = min(block_q, Lq)
+    bk = min(block_k, Lk)
+    if bq < 8 or bk < 8 or bq % 8 or bk % 8:  # TPU sublane tiling
+        return False
+    return Lq % bq == 0 and Lk % bk == 0

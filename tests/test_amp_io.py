@@ -43,8 +43,29 @@ def test_grad_scaler_skips_on_inf():
     scaler = pt.amp.GradScaler(init_loss_scaling=4.0)
     x.grad = pt.to_tensor([float("inf")])
     scaler.step(opt)
+    scaler.update()
     np.testing.assert_allclose(x.numpy(), [1.0])  # step skipped
     assert scaler.get_loss_scaling() < 4.0  # scale shrank
+
+
+def test_grad_scaler_two_optimizers_independent_inf():
+    # opt1's inf verdict must survive opt2's finite unscale (per-opt found_inf)
+    x1 = pt.parameter([1.0])
+    x2 = pt.parameter([1.0])
+    opt1 = pt.optimizer.SGD(learning_rate=0.1, parameters=[x1])
+    opt2 = pt.optimizer.SGD(learning_rate=0.1, parameters=[x2])
+    scaler = pt.amp.GradScaler(init_loss_scaling=4.0)
+    x1.grad = pt.to_tensor([float("inf")])
+    x2.grad = pt.to_tensor([4.0])
+    scaler.unscale_(opt1)
+    scaler.unscale_(opt2)
+    scaler.step(opt1)
+    scaler.step(opt2)
+    scaler.update()
+    np.testing.assert_allclose(x1.numpy(), [1.0])  # inf → skipped
+    np.testing.assert_allclose(x2.numpy(), [0.9], rtol=1e-5)  # 1 - 0.1*1
+    # the iteration saw an inf, so the per-iteration update must shrink
+    assert scaler.get_loss_scaling() < 4.0
 
 
 def test_amp_decorate_o2():

@@ -1,0 +1,103 @@
+"""Pallas flash attention vs the XLA sdpa reference, interpret mode on CPU.
+
+Mirrors the reference's flash-attn unit tests
+(test/legacy_test/test_flash_attention.py): forward allclose vs the
+naive softmax path, gradients allclose via vjp, causal and full.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas import flash_attention as fa
+from paddle_tpu.ops import call_raw
+from paddle_tpu.ops.nn_kernels import sdpa_k
+
+
+def _rand_qkv(rng, B, L, H, D, dtype=jnp.float32):
+    q = jnp.asarray(rng.standard_normal((B, L, H, D)), dtype)
+    k = jnp.asarray(rng.standard_normal((B, L, H, D)), dtype)
+    v = jnp.asarray(rng.standard_normal((B, L, H, D)), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [(2, 128, 2, 64), (1, 256, 4, 32)])
+def test_flash_forward_matches_sdpa(causal, shape):
+    rng = np.random.default_rng(0)
+    q, k, v = _rand_qkv(rng, *shape)
+    out = fa.flash_attention(q, k, v, is_causal=causal, interpret=True)
+    ref = sdpa_k(q, k, v, is_causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads_match_sdpa(causal):
+    rng = np.random.default_rng(1)
+    q, k, v = _rand_qkv(rng, 1, 128, 2, 64)
+
+    def loss_flash(q, k, v):
+        o = fa.flash_attention(q, k, v, is_causal=causal, interpret=True)
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(sdpa_k(q, k, v, is_causal=causal)))
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_flash_under_jit():
+    rng = np.random.default_rng(2)
+    q, k, v = _rand_qkv(rng, 1, 128, 2, 64)
+    f = jax.jit(lambda q, k, v: fa.flash_attention(
+        q, k, v, is_causal=True, interpret=True))
+    out = f(q, k, v)
+    ref = sdpa_k(q, k, v, is_causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_registry_override_falls_back_on_cpu():
+    # without PADDLE_TPU_PALLAS=interpret the CPU backend must use XLA sdpa
+    rng = np.random.default_rng(3)
+    q, k, v = _rand_qkv(rng, 1, 64, 2, 16)
+    out = call_raw("sdpa", q, k, v, None, is_causal=True)
+    ref = sdpa_k(q, k, v, is_causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+def test_registry_override_interpret(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_PALLAS", "interpret")
+    rng = np.random.default_rng(4)
+    q, k, v = _rand_qkv(rng, 2, 128, 2, 64)
+    out = call_raw("sdpa", q, k, v, None, is_causal=True)
+    ref = sdpa_k(q, k, v, is_causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_causal_cross_length():
+    # bottom-right-aligned causal (KV-cache prefill: Lk > Lq) must match the
+    # XLA path's jnp.tril(..., lk - lq) alignment
+    rng = np.random.default_rng(5)
+    B, H, D = 1, 2, 64
+    q = jnp.asarray(rng.standard_normal((B, 64, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, 128, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, 128, H, D)), jnp.float32)
+    out = fa.flash_attention(q, k, v, is_causal=True, interpret=True)
+    ref = sdpa_k(q, k, v, is_causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_supports_gate():
+    s = (2, 128, 4, 64)
+    assert fa.supports(s, s, None, jnp.float32)
+    assert not fa.supports(s, s, object(), jnp.float32)   # explicit mask
+    assert not fa.supports((2, 100, 4, 64), s, None, jnp.float32)  # ragged
+    assert not fa.supports(s, s, None, jnp.int32)
