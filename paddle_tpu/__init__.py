@@ -29,8 +29,12 @@ from . import distributed  # noqa: F401
 from . import vision  # noqa: F401
 from . import text  # noqa: F401
 from . import linalg  # noqa: F401
+from . import static  # noqa: F401
 from . import profiler  # noqa: F401
 from .framework.random import get_rng_state, set_rng_state  # noqa: F401
+from .framework import checkpoint  # noqa: F401
+from .framework.checkpoint import save_state, load_state  # noqa: F401
+from .jit import save, load  # noqa: F401  (paddle.save/paddle.load)
 
 # paddle-style aliases
 disable_static = lambda *a, **k: None   # always-dynamic by design

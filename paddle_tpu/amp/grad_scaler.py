@@ -38,9 +38,11 @@ class GradScaler:
         if not self._enable:
             return loss
         # a new iteration starts here: forget last iteration's unscale marks
-        # (covers users who unscaled but never stepped, e.g. on exceptions)
+        # (covers users who unscaled but never stepped, e.g. on exceptions).
+        # _iter_found_inf intentionally survives until update(): multi-loss
+        # iterations call scale() several times and an early inf must still
+        # shrink the scale.
         self._unscaled.clear()
-        self._iter_found_inf = False
         return loss * self._scale
 
     def _grads_finite(self, optimizer):

@@ -1,0 +1,156 @@
+"""Checkpoint / resume — orbax-backed training-state persistence.
+
+Reference surface: paddle.save/paddle.load on state_dicts plus the Fleet
+checkpoint utilities (python/paddle/framework/io.py,
+python/paddle/distributed/fleet/utils/fs.py checkpointing paths).
+TPU-native design: the array pytree (params, buffers, optimizer slots,
+PRNG key) goes through orbax — sharded-array aware, async-capable,
+atomic-rename on completion — while python scalars (step counters, LR
+scheduler state, GradScaler state, user extras) ride a JSON sidecar.
+Deterministic resume = params + optimizer slots + LR state + RNG key +
+step, all captured together.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+
+from ..tensor import Tensor
+from . import random as _random
+
+_ARRAYS = "arrays"
+_META = "meta.json"
+
+
+def _esc(k):
+    # orbax stores tree keys as filesystem path components; optimizer slot
+    # keys ("linear.weight/moment1") contain "/" and must be escaped
+    return k.replace("/", "╱")
+
+
+def _unesc(k):
+    return k.replace("╱", "/")
+
+
+def _split_state_dict(sd):
+    """Split a (possibly nested) state_dict into arrays vs json scalars."""
+    arrays, meta = {}, {}
+    for k, v in sd.items():
+        k = _esc(str(k))
+        if isinstance(v, Tensor):
+            arrays[k] = np.asarray(v._array)
+        elif isinstance(v, (jax.Array, np.ndarray)):
+            arrays[k] = np.asarray(v)
+        elif isinstance(v, dict):
+            a, m = _split_state_dict(v)
+            if a:
+                arrays[k] = a
+            if m:
+                meta[k] = m
+        else:
+            meta[k] = v
+    return arrays, meta
+
+
+def _merge_state_dict(arrays, meta):
+    out = {}
+    for k, v in (arrays or {}).items():
+        out[_unesc(k)] = _merge_state_dict(v, (meta or {}).get(k)) \
+            if isinstance(v, dict) else Tensor._from_array(v)
+    for k, v in (meta or {}).items():
+        if _unesc(k) not in out:
+            out[_unesc(k)] = v
+    return out
+
+
+def _checkpointer():
+    # always the async checkpointer: its wait_until_finished() is the only
+    # reliable completion barrier (the sync Checkpointer finalizes the
+    # atomic directory rename on a background thread)
+    import orbax.checkpoint as ocp
+    return ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+
+
+def save_state(path, model=None, optimizer=None, scaler=None, step=0,
+               extra=None, async_save=False):
+    """Save a complete, deterministically-resumable training state.
+
+    `path` is a directory; arrays go to `<path>/arrays` (orbax), scalars
+    to `<path>/meta.json`.  Pass `async_save=True` to overlap the device→
+    host copy + write with training (orbax async checkpointer).
+    """
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    arrays, meta = {}, {"step": int(step)}
+    if model is not None:
+        a, m = _split_state_dict(dict(model.state_dict()))
+        arrays["model"] = a
+        if m:
+            meta["model"] = m
+    if optimizer is not None:
+        a, m = _split_state_dict(optimizer.state_dict())
+        if a:
+            arrays["optimizer"] = a
+        if m:
+            meta["optimizer"] = m
+    if scaler is not None:
+        meta["scaler"] = scaler.state_dict()
+    rng = _random.get_rng_state()
+    arrays["rng_key"] = np.asarray(rng["key"])
+    meta["rng_seed"] = rng["seed"]
+    if extra is not None:
+        meta["extra"] = extra
+
+    ckptr = _checkpointer()
+    ckptr.save(os.path.join(path, _ARRAYS), arrays, force=True)
+    # meta.json is the checkpoint's commit marker: stage it now, publish it
+    # (atomic rename) only after the orbax array write has committed, so a
+    # crash mid-save can never pair new meta with old arrays
+    tmp = os.path.join(path, _META + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    handle = _SaveHandle(ckptr, tmp, os.path.join(path, _META))
+    if async_save:
+        return handle  # caller should .wait_until_finished()
+    handle.wait_until_finished()
+    return None
+
+
+class _SaveHandle:
+    def __init__(self, ckptr, tmp_meta, meta):
+        self._ckptr = ckptr
+        self._tmp_meta = tmp_meta
+        self._meta = meta
+
+    def wait_until_finished(self):
+        self._ckptr.wait_until_finished()
+        if os.path.exists(self._tmp_meta):
+            os.replace(self._tmp_meta, self._meta)
+
+
+def load_state(path, model=None, optimizer=None, scaler=None):
+    """Restore state saved by `save_state` in place; returns the meta dict
+    (step, extra, ...)."""
+    path = os.path.abspath(path)
+    ckptr = _checkpointer()
+    arrays = ckptr.restore(os.path.join(path, _ARRAYS))
+    with open(os.path.join(path, _META)) as f:
+        meta = json.load(f)
+    if model is not None and "model" in arrays:
+        sd = _merge_state_dict(arrays["model"], meta.get("model"))
+        model.set_state_dict(sd)
+    if optimizer is not None:
+        sd = _merge_state_dict(arrays.get("optimizer", {}),
+                               meta.get("optimizer"))
+        sd.setdefault("step", meta.get("step", 0))
+        optimizer.set_state_dict(sd)
+    if scaler is not None and "scaler" in meta:
+        scaler.load_state_dict(meta["scaler"])
+    if "rng_key" in arrays:
+        _random.set_rng_state({
+            "key": jax.numpy.asarray(arrays["rng_key"]),
+            "seed": meta.get("rng_seed", 0)})
+    return meta

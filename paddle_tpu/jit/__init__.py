@@ -21,6 +21,7 @@ from ..tensor import Tensor
 from ..nn.layer import Layer
 from . import functional_bridge as FB
 from .train_step import train_step, TrainStep  # noqa: F401
+from .save_load import InputSpec, TranslatedLayer  # noqa: F401
 
 
 class StaticFunction:
@@ -159,8 +160,18 @@ def not_to_static(fn):
 
 
 # ------------------------------------------------------------- save / load
-def save(obj, path, **kwargs):
-    """paddle.save: state_dicts / Tensors / nested python objects."""
+def save(obj, path, input_spec=None, **kwargs):
+    """paddle.save / paddle.jit.save.
+
+    A Layer (or to_static-wrapped Layer) with `input_spec` exports a
+    serialized StableHLO inference program (reference: jit.save →
+    .pdmodel); anything else pickles like paddle.save.
+    """
+    from .save_load import save_inference
+    if isinstance(obj, (Layer, StaticFunction)):
+        if input_spec is None:
+            raise ValueError("jit.save of a Layer requires input_spec")
+        return save_inference(obj, path, input_spec)
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     import numpy as np
 
@@ -179,6 +190,9 @@ def save(obj, path, **kwargs):
 
 
 def load(path, **kwargs):
+    from .save_load import is_inference_dir, load_inference
+    if is_inference_dir(path):
+        return load_inference(path)
     with open(path, "rb") as f:
         obj = pickle.load(f)
 

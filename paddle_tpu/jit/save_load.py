@@ -1,0 +1,164 @@
+"""Inference export — paddle.jit.save / paddle.jit.load parity.
+
+Reference: python/paddle/jit/api.py (jit.save serializes the dy2static
+Program + params to .pdmodel/.pdiparams; jit.load returns a
+TranslatedLayer that replays the program).  TPU-native: the traced XLA
+computation is serialized as portable StableHLO via `jax.export`, params
+and buffers ride an .npz, and `load` returns a TranslatedLayer-like
+callable that replays the compiled program — no Python model code needed
+at load time, same as the reference's deployment story.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jexport
+
+from ..dtypes import convert_dtype
+from ..tensor import Tensor
+from . import functional_bridge as FB
+
+_MODEL = "model.stablehlo"
+_PARAMS = "params.npz"
+_META = "inference_meta.json"
+
+
+class InputSpec:
+    """paddle.static.InputSpec parity: symbolic input signature.
+
+    `None` dims become export symbols (polymorphic batch, etc.).
+    """
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, t, name=None):
+        return cls(tuple(t.shape), t.dtype, name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def _to_spec(s):
+    if isinstance(s, InputSpec):
+        return s
+    if isinstance(s, Tensor):
+        return InputSpec(tuple(s.shape), s._array.dtype)
+    if hasattr(s, "shape") and hasattr(s, "dtype"):
+        return InputSpec(tuple(s.shape), s.dtype)
+    raise TypeError(f"bad input_spec entry: {s!r}")
+
+
+def _shape_structs(specs):
+    """ShapeDtypeStructs for the export trace; None dims → shared-scope
+    export symbols so one program serves any batch size."""
+    has_dynamic = any(d is None for s in specs for d in s.shape)
+    scope = jexport.SymbolicScope() if has_dynamic else None
+    out = []
+    sym_i = 0
+    for s in specs:
+        parts = []
+        for d in s.shape:
+            if d is None:
+                parts.append(f"_d{sym_i}")
+                sym_i += 1
+            else:
+                parts.append(str(d))
+        if any(p.startswith("_d") for p in parts):
+            shape = jexport.symbolic_shape(", ".join(parts), scope=scope)
+        else:
+            shape = tuple(int(d) for d in s.shape)
+        out.append(jax.ShapeDtypeStruct(shape, s.dtype))
+    return out
+
+
+def save_inference(layer, path, input_spec):
+    """Trace `layer.forward` over `input_spec` (eval mode) and serialize the
+    StableHLO program + params to directory `path`."""
+    from ..nn.layer import Layer
+    if not isinstance(layer, Layer):  # StaticFunction wrapper
+        layer = layer.layer
+    specs = [_to_spec(s) for s in input_spec]
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+
+    pn, pa, bn, ba = FB.split_state(layer)
+    # eval() recurses into sublayers, so capture every layer's mode
+    prev_modes = [(l, l.training) for l in [layer] + list(layer.sublayers())]
+    layer.eval()
+    try:
+        def pure(p_arrays, b_arrays, in_arrays):
+            out, _ = FB.call_functional(
+                layer, p_arrays, b_arrays, in_arrays,
+                rng_key=jax.random.PRNGKey(0))
+            return out
+
+        in_structs = _shape_structs(specs)
+        p_structs = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in pa]
+        b_structs = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in ba]
+        exported = jexport.export(jax.jit(pure))(
+            p_structs, b_structs, in_structs)
+    finally:
+        for l, mode in prev_modes:
+            l.training = mode
+
+    with open(os.path.join(path, _MODEL), "wb") as f:
+        f.write(exported.serialize())
+    np.savez(os.path.join(path, _PARAMS),
+             **{f"p{i}": np.asarray(a) for i, a in enumerate(pa)},
+             **{f"b{i}": np.asarray(a) for i, a in enumerate(ba)})
+    with open(os.path.join(path, _META), "w") as f:
+        json.dump({"n_params": len(pa), "n_buffers": len(ba),
+                   "param_names": pn, "buffer_names": bn,
+                   "input_spec": [{"shape": [d if d is None else int(d)
+                                             for d in s.shape],
+                                   "dtype": str(np.dtype(s.dtype))}
+                                  for s in specs]}, f)
+
+
+class TranslatedLayer:
+    """Replays a serialized inference program (reference: TranslatedLayer)."""
+
+    def __init__(self, exported, params, buffers, meta):
+        self._exported = exported
+        self._params = params
+        self._buffers = buffers
+        self._meta = meta
+
+    def __call__(self, *inputs):
+        arrays = [i._array if isinstance(i, Tensor) else jnp.asarray(i)
+                  for i in inputs]
+        out = self._exported.call(self._params, self._buffers, arrays)
+        return FB._rewrap(out)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is inference-only")
+
+
+def load_inference(path):
+    path = os.path.abspath(path)
+    with open(os.path.join(path, _MODEL), "rb") as f:
+        exported = jexport.deserialize(f.read())
+    with open(os.path.join(path, _META)) as f:
+        meta = json.load(f)
+    z = np.load(os.path.join(path, _PARAMS))
+    params = [jnp.asarray(z[f"p{i}"]) for i in range(meta["n_params"])]
+    buffers = [jnp.asarray(z[f"b{i}"]) for i in range(meta["n_buffers"])]
+    return TranslatedLayer(exported, params, buffers, meta)
+
+
+def is_inference_dir(path):
+    return os.path.isdir(path) and \
+        os.path.exists(os.path.join(path, _MODEL))

@@ -319,7 +319,7 @@ def flash_attention(q, k, v, is_causal=False, scale=None,
     return o.reshape(B, H, Lq, D).transpose(0, 2, 1, 3)
 
 
-def supports(q_shape, k_shape, mask, dtype, v_shape=None,
+def supports(q_shape, k_shape, mask, dtype, v_shape=None, is_causal=False,
              block_q=512, block_k=512):
     """Shape/dtype gate for the pallas path; anything else → XLA sdpa."""
     if pltpu is None:  # no TPU pallas support in this jax build
@@ -332,6 +332,8 @@ def supports(q_shape, k_shape, mask, dtype, v_shape=None,
     Lk = k_shape[1]
     if k_shape[2] != H:  # GQA repeat handled by callers before sdpa
         return False
+    if is_causal and Lq > Lk:  # fully-masked rows: XLA gives NaN, kernel
+        return False           # gives 0 — fall back to keep numerics equal
     if k_shape[3] != D:
         return False
     if v_shape is not None and tuple(v_shape) != tuple(k_shape):

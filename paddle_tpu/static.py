@@ -1,0 +1,7 @@
+"""paddle.static compatibility surface.
+
+The reference's static-graph Program API is replaced wholesale by
+paddle_tpu.jit (trace → XLA); what remains here is the part user code
+actually imports: InputSpec (python/paddle/static/input.py).
+"""
+from .jit.save_load import InputSpec  # noqa: F401
