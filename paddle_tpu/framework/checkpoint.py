@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import os
+import uuid
 
 import jax
 import numpy as np
@@ -103,6 +104,13 @@ def save_state(path, model=None, optimizer=None, scaler=None, step=0,
     meta["rng_seed"] = rng["seed"]
     if extra is not None:
         meta["extra"] = extra
+    # commit token pairing this meta with exactly these arrays: a crash
+    # while overwriting a checkpoint leaves a detectable mismatch (load
+    # raises) instead of silently resuming new params with old step/LR
+    token = uuid.uuid4().hex
+    arrays["commit_token"] = np.frombuffer(bytes.fromhex(token),
+                                           dtype=np.uint8).copy()
+    meta["commit_token"] = token
 
     ckptr = _checkpointer()
     ckptr.save(os.path.join(path, _ARRAYS), arrays, force=True)
@@ -139,6 +147,13 @@ def load_state(path, model=None, optimizer=None, scaler=None):
     arrays = ckptr.restore(os.path.join(path, _ARRAYS))
     with open(os.path.join(path, _META)) as f:
         meta = json.load(f)
+    want = meta.get("commit_token")
+    got = arrays.get("commit_token")
+    if want is not None and (
+            got is None or bytes(np.asarray(got)).hex() != want):
+        raise RuntimeError(
+            f"checkpoint {path} is inconsistent (meta/arrays from "
+            f"different saves — interrupted overwrite?)")
     if model is not None and "model" in arrays:
         sd = _merge_state_dict(arrays["model"], meta.get("model"))
         model.set_state_dict(sd)

@@ -1,20 +1,24 @@
 """paddle.io equivalent: Dataset / DataLoader (reference: python/paddle/io/).
 
-The reference uses C++ worker processes + shared-memory queues; the
-TPU-native loader uses a thread pool with double-buffered host→device
-prefetch (XLA's async dispatch overlaps the copy with compute).  A
-C-accelerated shared-memory ring is planned in io/native.
+Like the reference (C++ worker processes + shared-memory queues), heavy
+loading runs in forked worker processes that ship collated numpy batches
+to the trainer through a native shared-memory ring (io/native/ring.c);
+datasets whose samples already live on device fall back to a thread pool
+(XLA's async dispatch overlaps host→device copy with compute).
 """
 from __future__ import annotations
 
 import itertools
 import math
+import os
 import queue
 import threading
 
 import numpy as np
 
 from ..tensor import Tensor
+from . import native
+from .shm_loader import ShmWorkerPool, get_worker_info, WorkerInfo  # noqa: F401
 
 
 class Dataset:
@@ -193,6 +197,28 @@ class DistributedBatchSampler(BatchSampler):
         return math.ceil(self.num_samples / self.batch_size)
 
 
+def _host_only(obj):
+    """True if the pytree holds no device-backed (jax) arrays."""
+    if isinstance(obj, Tensor):
+        return False
+    if isinstance(obj, (list, tuple)):
+        return all(_host_only(o) for o in obj)
+    if isinstance(obj, dict):
+        return all(_host_only(v) for v in obj.values())
+    return True
+
+
+def _rewrap_numpy(obj):
+    """Parent-side: numpy arrays from the ring become Tensors."""
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_rewrap_numpy(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _rewrap_numpy(v) for k, v in obj.items()}
+    return obj
+
+
 def default_collate_fn(batch):
     item = batch[0]
     if isinstance(item, (tuple, list)):
@@ -202,10 +228,26 @@ def default_collate_fn(batch):
         return {k: default_collate_fn([b[k] for b in batch]) for k in item}
     if isinstance(item, Tensor):
         return Tensor(np.stack([np.asarray(b._array) for b in batch]))
-    if isinstance(item, np.ndarray):
+    if isinstance(item, (np.ndarray, np.generic)):
         return Tensor(np.stack(batch))
     if isinstance(item, (int, float)):
         return Tensor(np.asarray(batch))
+    return batch
+
+
+def _numpy_collate(batch):
+    """default_collate for worker processes: numpy out, never touches jax
+    (forked children must not use the inherited TPU client)."""
+    item = batch[0]
+    if isinstance(item, (tuple, list)):
+        return type(item)(_numpy_collate([b[i] for b in batch])
+                          for i in range(len(item)))
+    if isinstance(item, dict):
+        return {k: _numpy_collate([b[k] for b in batch]) for k in item}
+    if isinstance(item, (np.ndarray, np.generic)):
+        return np.stack(batch)
+    if isinstance(item, (int, float)):
+        return np.asarray(batch)
     return batch
 
 
@@ -214,11 +256,17 @@ class DataLoader:
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
-                 timeout=0, worker_init_fn=None, persistent_workers=False):
+                 timeout=0, worker_init_fn=None, persistent_workers=False,
+                 use_shared_memory=True, ring_bytes=None):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 1)
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = use_shared_memory
+        self.ring_bytes = ring_bytes
+        self._probe_host = None  # cached host-only probe (map-style)
         self._iterable = isinstance(dataset, IterableDataset)
         if not self._iterable:
             self.batch_sampler = batch_sampler or BatchSampler(
@@ -250,7 +298,71 @@ class DataLoader:
             for samples in self._index_batches():
                 yield self.collate_fn(samples)
             return
+        if self._use_process_workers():
+            yield from self._process_iter()
+            return
         yield from self._threaded_iter()
+
+    # ------------------------------------------------- process workers
+    def _use_process_workers(self):
+        if not (self.use_shared_memory and native.available()
+                and hasattr(os, "fork")):
+            return False
+        if self._iterable:
+            # no sample probe: iterating could consume a single-use stream.
+            # Workers convert to numpy and fail loudly on device-backed
+            # samples under a TPU backend (shm_loader._to_numpy_tree).
+            return True
+        if self._probe_host is None:
+            # device-backed samples must not cross fork(): probe ONE sample,
+            # once per DataLoader (not per epoch)
+            try:
+                self._probe_host = _host_only(self.dataset[0])
+            except Exception:
+                self._probe_host = False
+        return self._probe_host
+
+    @staticmethod
+    def _device_unsafe():
+        import jax
+        try:
+            return jax.default_backend() != "cpu"
+        except Exception:  # pragma: no cover
+            return True
+
+    def _process_iter(self):
+        dataset = self.dataset
+        if self._iterable:
+            batch_size = self.batch_size
+
+            def batch_iter_fn(worker_id, num_workers):
+                # reference semantics: the loader does NOT shard an
+                # IterableDataset — the dataset itself consults
+                # get_worker_info() (set before this runs) and yields its
+                # own shard; a dataset that ignores it is replicated
+                # per worker, exactly like the reference/torch loaders
+                it = iter(dataset)
+                while True:
+                    batch = list(itertools.islice(it, batch_size))
+                    if not batch:
+                        return
+                    yield batch
+        else:
+            index_lists = list(self.batch_sampler)
+
+            def batch_iter_fn(worker_id, num_workers):
+                for bi in range(worker_id, len(index_lists), num_workers):
+                    yield [dataset[i] for i in index_lists[bi]]
+
+        worker_collate = _numpy_collate \
+            if self.collate_fn is default_collate_fn else self.collate_fn
+        pool = ShmWorkerPool(
+            self.num_workers, dataset, batch_iter_fn, worker_collate,
+            worker_init_fn=self.worker_init_fn,
+            **({"ring_bytes": self.ring_bytes} if self.ring_bytes else {}),
+            timeout_s=self.timeout, device_unsafe=self._device_unsafe())
+        for batch in pool:
+            yield _rewrap_numpy(batch)
 
     def _threaded_iter(self):
         q: "queue.Queue" = queue.Queue(

@@ -1,0 +1,56 @@
+"""Build + bind the native shared-memory ring (ring.c) via ctypes.
+
+The .so is compiled on first import with g++ (cached next to the source,
+keyed by source mtime) — the TPU image ships the toolchain but no
+pybind11, so the binding is plain ctypes over an extern-C surface.
+Import failure (no compiler, exotic platform) degrades gracefully:
+`LIB` stays None and the DataLoader falls back to thread prefetch.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "ring.cc")
+_SO = os.path.join(_DIR, "_ring.so")
+
+LIB = None
+
+
+def _build():
+    if (os.path.exists(_SO)
+            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+        return _SO
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", _SO + ".tmp", _SRC]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(_SO + ".tmp", _SO)
+    return _SO
+
+
+def _bind(path):
+    lib = ctypes.CDLL(path)
+    lib.ring_hdr_size.restype = ctypes.c_uint64
+    lib.ring_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.ring_init.restype = ctypes.c_int
+    lib.ring_close.argtypes = [ctypes.c_void_p]
+    lib.ring_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_uint64, ctypes.c_long]
+    lib.ring_write.restype = ctypes.c_long
+    lib.ring_next_len.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    lib.ring_next_len.restype = ctypes.c_long
+    lib.ring_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                              ctypes.c_uint64]
+    lib.ring_read.restype = ctypes.c_long
+    return lib
+
+
+try:
+    LIB = _bind(_build())
+except Exception:  # pragma: no cover - toolchain missing
+    LIB = None
+
+
+def available():
+    return LIB is not None

@@ -1,0 +1,219 @@
+"""Multi-process DataLoader workers over the native shared-memory ring.
+
+Reference parity: python/paddle/io/dataloader/dataloader_iter.py
+(_DataLoaderIterMultiProcess) + its C++ shared-memory transport.  Design:
+each worker is a forked process owning one SPSC ring (ring.c) mapped into
+an anonymous shared mmap; worker w produces batches w, w+W, w+2W, ... so
+the parent reads rings round-robin and global batch order is preserved
+without any cross-process coordination.  Payloads are pickle protocol-5
+blobs of numpy pytrees — workers never touch jax or the TPU client; the
+parent converts to Tensors after receipt.
+"""
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import pickle
+import signal
+import traceback
+
+import numpy as np
+
+from . import native
+
+_DEFAULT_RING_BYTES = 64 << 20
+_WORKER_INFO = None
+
+
+class WorkerInfo:
+    """paddle.io.get_worker_info parity for IterableDataset sharding."""
+
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+def get_worker_info():
+    return _WORKER_INFO
+
+
+class _Ring:
+    """Parent-side handle to one worker's shared ring."""
+
+    def __init__(self, size=_DEFAULT_RING_BYTES):
+        self.mm = mmap.mmap(-1, size)  # anonymous shared, fork-inherited
+        self._buf = ctypes.c_char.from_buffer(self.mm)
+        self.addr = ctypes.addressof(self._buf)
+        if native.LIB.ring_init(self.addr, size) != 0:
+            raise RuntimeError("ring_init failed")
+
+    def write(self, payload: bytes, timeout_ms=-1):
+        r = native.LIB.ring_write(self.addr, payload, len(payload),
+                                  timeout_ms)
+        if r == -1:
+            raise ValueError(
+                f"batch of {len(payload)} bytes exceeds the shared ring "
+                f"capacity; raise DataLoader(..., ring_bytes=)")
+        if r == -2:
+            raise TimeoutError("ring_write timed out (consumer stalled)")
+
+    def close_producer(self):
+        native.LIB.ring_close(self.addr)
+
+    def next_len(self, timeout_ms):
+        return native.LIB.ring_next_len(self.addr, timeout_ms)
+
+    def read(self, n):
+        out = ctypes.create_string_buffer(n)
+        got = native.LIB.ring_read(self.addr, out, n)
+        if got < 0:
+            raise RuntimeError(f"ring_read error {got}")
+        return out.raw[:got]
+
+    def release(self):
+        # drop the exported buffer before closing the mmap
+        self._buf = None
+        try:
+            self.mm.close()
+        except BufferError:  # pragma: no cover
+            pass
+
+
+def _to_numpy_tree(obj, device_unsafe):
+    """Convert a batch pytree to pure numpy/python for pickling.
+
+    `device_unsafe` is the parent's pre-fork verdict (non-CPU jax backend):
+    converting a device-backed Tensor would use the inherited TPU client in
+    the forked child — fail loudly instead of deadlocking the tunnel.
+    """
+    from ..tensor import Tensor
+    if isinstance(obj, Tensor):
+        if device_unsafe:
+            raise RuntimeError(
+                "DataLoader worker produced a device-backed Tensor; with a "
+                "TPU backend, datasets/collate_fn used with num_workers>0 "
+                "must return numpy (or pass use_shared_memory=False)")
+        return np.asarray(obj._array)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_numpy_tree(o, device_unsafe) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _to_numpy_tree(v, device_unsafe) for k, v in obj.items()}
+    return obj
+
+
+def _worker_main(ring, worker_id, num_workers, dataset, batch_iter_fn,
+                 collate_fn, init_fn, device_unsafe):
+    """Runs in the forked child: produce this worker's batch slice."""
+    global _WORKER_INFO
+    _WORKER_INFO = WorkerInfo(worker_id, num_workers, dataset)
+    signal.signal(signal.SIGINT, signal.SIG_IGN)  # parent handles ^C
+    try:
+        if init_fn is not None:
+            init_fn(worker_id)
+        for samples in batch_iter_fn(worker_id, num_workers):
+            batch = _to_numpy_tree(collate_fn(samples), device_unsafe)
+            ring.write(b"B" + pickle.dumps(batch, protocol=5))
+    except BaseException as e:
+        try:
+            payload = pickle.dumps((e, traceback.format_exc()))
+        except Exception:  # unpicklable exception: ship the text only
+            payload = pickle.dumps((None, traceback.format_exc()))
+        try:
+            ring.write(b"E" + payload)
+        except Exception:
+            pass
+    finally:
+        ring.close_producer()
+
+
+class ShmWorkerPool:
+    """Fork N workers, read their rings round-robin in batch order."""
+
+    _POLL_MS = 100  # bounded ring polls so worker death is noticed
+
+    def __init__(self, num_workers, dataset, batch_iter_fn, collate_fn,
+                 worker_init_fn=None, ring_bytes=_DEFAULT_RING_BYTES,
+                 timeout_s=0, device_unsafe=False):
+        self._rings = [_Ring(ring_bytes) for _ in range(num_workers)]
+        self._timeout_ms = int(timeout_s * 1000) if timeout_s else -1
+        self._pids = []
+        self._exited = set()
+        for w in range(num_workers):
+            pid = os.fork()
+            if pid == 0:  # child
+                code = 1
+                try:
+                    _worker_main(self._rings[w], w, num_workers, dataset,
+                                 batch_iter_fn, collate_fn, worker_init_fn,
+                                 device_unsafe)
+                    code = 0
+                finally:
+                    os._exit(code)  # skip parent atexit/GC (jax client!)
+            self._pids.append(pid)
+
+    def _worker_dead(self, ring):
+        """True if this ring's worker exited without closing the ring
+        (SIGKILL/OOM/segfault) — data will never arrive."""
+        pid = self._pids[self._rings.index(ring)]
+        if pid in self._exited:
+            return True
+        try:
+            got, _ = os.waitpid(pid, os.WNOHANG)
+        except ChildProcessError:
+            got = pid
+        if got == pid:
+            self._exited.add(pid)
+            return True
+        return False
+
+    def __iter__(self):
+        live = list(self._rings)
+        w = 0
+        waited_ms = 0
+        try:
+            while live:
+                ring = live[w % len(live)]
+                n = ring.next_len(self._POLL_MS)
+                if n == -2:  # nothing yet: check liveness + user timeout
+                    if self._worker_dead(ring) and \
+                            ring.next_len(0) == -2:
+                        raise RuntimeError(
+                            "DataLoader worker process died unexpectedly "
+                            "(killed / OOM?)")
+                    waited_ms += self._POLL_MS
+                    if 0 <= self._timeout_ms < waited_ms:
+                        raise TimeoutError("DataLoader worker timed out")
+                    continue
+                waited_ms = 0
+                if n == -1:  # this worker is done
+                    live.remove(ring)
+                    continue
+                payload = ring.read(n)
+                if payload[:1] == b"E":
+                    exc, tb = pickle.loads(payload[1:])
+                    if exc is not None:  # re-raise with original type
+                        raise exc from RuntimeError(
+                            "DataLoader worker failed:\n" + tb)
+                    raise RuntimeError("DataLoader worker failed:\n" + tb)
+                yield pickle.loads(payload[1:])
+                w += 1
+        finally:
+            self.shutdown()
+
+    def shutdown(self):
+        for pid in self._pids:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        for pid in self._pids:
+            try:
+                os.waitpid(pid, 0)
+            except ChildProcessError:
+                pass
+        self._pids = []
+        for r in self._rings:
+            r.release()
+        self._rings = []

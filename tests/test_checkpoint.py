@@ -84,6 +84,21 @@ def test_lr_scheduler_in_checkpoint(tmp_path):
     assert sched2.get_lr() == pytest.approx(sched.get_lr())
 
 
+def test_inconsistent_checkpoint_detected(tmp_path):
+    import json
+    m, opt = _make()
+    path = tmp_path / "ck"
+    pt.save_state(str(path), model=m, optimizer=opt, step=1)
+    # simulate a crash mid-overwrite: meta from a different save
+    meta_file = path / "meta.json"
+    meta = json.loads(meta_file.read_text())
+    meta["commit_token"] = "00" * 16
+    meta_file.write_text(json.dumps(meta))
+    m2, opt2 = _make(seed=1)
+    with pytest.raises(RuntimeError, match="inconsistent"):
+        pt.load_state(str(path), model=m2, optimizer=opt2)
+
+
 def test_jit_save_load_inference(tmp_path):
     pt.seed(0)
     m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))

@@ -1,0 +1,162 @@
+"""Native shared-memory ring + multi-process DataLoader workers.
+
+Mirrors the reference's multiprocess DataLoader tests
+(test/legacy_test/test_multiprocess_dataloader_*.py): order preservation,
+content equality vs single-process, worker crash propagation, iterable
+sharding.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.io import (DataLoader, Dataset, IterableDataset,
+                           get_worker_info, native)
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native ring unavailable")
+
+
+class NpDataset(Dataset):
+    def __init__(self, n=37, dim=5):
+        rng = np.random.default_rng(0)
+        self.x = rng.standard_normal((n, dim)).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], np.int64(i)
+
+
+def test_ring_roundtrip():
+    from paddle_tpu.io.shm_loader import _Ring
+    r = _Ring(1 << 16)
+    for payload in (b"x", b"y" * 1000, b"z" * 30000):
+        r.write(payload)
+        n = r.next_len(1000)
+        assert n == len(payload)
+        assert r.read(n) == payload
+    r.close_producer()
+    assert r.next_len(1000) == -1
+    r.release()
+
+
+def test_ring_wraparound():
+    from paddle_tpu.io.shm_loader import _Ring
+    r = _Ring(native.LIB.ring_hdr_size() + 256)
+    for i in range(50):  # forces many wraps of the 256-byte data region
+        msg = bytes([i]) * (i % 100 + 1)
+        r.write(msg)
+        n = r.next_len(1000)
+        assert r.read(n) == msg
+    r.release()
+
+
+def test_process_loader_matches_serial():
+    ds = NpDataset()
+    serial = [b for b in DataLoader(ds, batch_size=4, num_workers=0)]
+    multi = [b for b in DataLoader(ds, batch_size=4, num_workers=3)]
+    assert len(serial) == len(multi)
+    for (xa, ia), (xb, ib) in zip(serial, multi):
+        np.testing.assert_allclose(xa.numpy(), xb.numpy())
+        np.testing.assert_array_equal(ia.numpy(), ib.numpy())
+
+
+def test_process_loader_large_batches():
+    class Big(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return np.full((64, 64), i, np.float32)
+
+    batches = [b for b in DataLoader(Big(), batch_size=2, num_workers=2)]
+    assert len(batches) == 4
+    assert batches[2].numpy()[0, 0, 0] == 4.0
+
+
+def test_worker_exception_propagates():
+    class Bad(Dataset):
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            if i == 5:
+                raise ValueError("boom at 5")
+            return np.zeros(3, np.float32)
+
+    with pytest.raises(ValueError, match="boom at 5"):
+        list(DataLoader(Bad(), batch_size=2, num_workers=2))
+
+
+def test_iterable_dataset_self_sharding():
+    # reference semantics: the dataset consults get_worker_info() and
+    # yields its own shard; the loader must not shard a second time
+    class Stream(IterableDataset):
+        def __iter__(self):
+            info = get_worker_info()
+            data = np.arange(20, dtype=np.int64)
+            if info is not None:
+                data = data[info.id::info.num_workers]
+            return iter(data)
+
+    got = []
+    for b in DataLoader(Stream(), batch_size=3, num_workers=2):
+        got.extend(np.atleast_1d(b.numpy()).tolist())
+    assert sorted(got) == list(range(20))
+
+
+def test_iterable_dataset_naive_replicates():
+    # a dataset that ignores worker info is replicated per worker,
+    # matching the reference/torch loaders
+    class Naive(IterableDataset):
+        def __iter__(self):
+            return iter(np.arange(6, dtype=np.int64))
+
+    got = []
+    for b in DataLoader(Naive(), batch_size=2, num_workers=2):
+        got.extend(np.atleast_1d(b.numpy()).tolist())
+    assert sorted(got) == sorted(list(range(6)) * 2)
+
+
+def test_dead_worker_raises_not_hangs():
+    import os as _os
+    import signal as _signal
+
+    class Suicide(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            if i == 4:  # batch 2 → worker 0's second batch
+                _os.kill(_os.getpid(), _signal.SIGKILL)
+            return np.zeros(2, np.float32)
+
+    with pytest.raises(RuntimeError, match="died unexpectedly"):
+        list(DataLoader(Suicide(), batch_size=2, num_workers=2))
+
+
+def test_worker_init_fn_and_info():
+    seen = []
+
+    class Probe(Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            info = get_worker_info()
+            return np.int64(info.id if info else -1)
+
+    out = []
+    for b in DataLoader(Probe(), batch_size=1, num_workers=2):
+        out.extend(np.atleast_1d(b.numpy()).tolist())
+    # batches 0,2 from worker 0; 1,3 from worker 1
+    assert out == [0, 1, 0, 1]
+
+
+def test_device_backed_dataset_falls_back_to_threads():
+    import paddle_tpu as pt
+    from paddle_tpu.io import TensorDataset
+    X = pt.randn([10, 4])
+    dl = DataLoader(TensorDataset([X]), batch_size=5, num_workers=2)
+    assert not dl._use_process_workers()
+    assert len(list(dl)) == 2
