@@ -1,0 +1,145 @@
+"""paddle_tpu.distributed.launch — the multi-host process runner.
+
+Reference: python/paddle/distributed/launch/ (`python -m
+paddle.distributed.launch --nnodes ... train.py`), which sets up
+per-rank env, starts workers, watches them, and supports elastic
+restart.  TPU-native shape: ONE controller process per host (XLA drives
+every local chip), so `--nproc_per_node` exists mainly for CPU-mesh
+testing and per-process-per-chip setups; ranks coordinate through
+jax.distributed.initialize (gRPC coordinator at `--master`), which
+`paddle_tpu.distributed.init_parallel_env()` reads from the PT_*
+variables this launcher exports.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse_args(argv):
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch distributed training "
+                    "(reference: paddle.distributed.launch)")
+    p.add_argument("--nnodes", type=int, default=1,
+                   help="number of hosts")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PT_NODE_RANK", "0")),
+                   help="this host's index")
+    p.add_argument("--master", default=os.environ.get("PT_MASTER",
+                                                      "127.0.0.1:8476"),
+                   help="coordinator ip:port (rank-0 host)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per host (1 for TPU single-controller)")
+    p.add_argument("--log_dir", default=None,
+                   help="per-rank stdout/stderr capture directory")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="elastic: restart failed workers this many times")
+    p.add_argument("--devices", default=None,
+                   help="accepted for reference compat (unused on TPU)")
+    p.add_argument("script", help="training script")
+    p.add_argument("script_args", nargs="...",
+                   help="arguments passed through to the script")
+    return p.parse_args(argv)
+
+
+def _worker_env(args, local_rank):
+    env = dict(os.environ)
+    world = args.nnodes * args.nproc_per_node
+    rank = args.node_rank * args.nproc_per_node + local_rank
+    env["PT_COORDINATOR"] = args.master
+    env["PT_NUM_PROCESSES"] = str(world)
+    env["PT_PROCESS_ID"] = str(rank)
+    env["PT_LOCAL_RANK"] = str(local_rank)
+    # reference-compatible aliases user scripts may read
+    env["PADDLE_TRAINER_ID"] = str(rank)
+    env["PADDLE_TRAINERS_NUM"] = str(world)
+    return env
+
+
+class _Worker:
+    def __init__(self, args, local_rank):
+        self.args = args
+        self.local_rank = local_rank
+        self.restarts = 0
+        self.proc = None
+        self.log = None
+
+    def start(self):
+        cmd = [sys.executable, self.args.script] + self.args.script_args
+        stdout = stderr = None
+        if self.args.log_dir:
+            os.makedirs(self.args.log_dir, exist_ok=True)
+            rank = self.args.node_rank * self.args.nproc_per_node + \
+                self.local_rank
+            self.log = open(os.path.join(self.args.log_dir,
+                                         f"worker.{rank}.log"), "ab")
+            stdout = stderr = self.log
+        self.proc = subprocess.Popen(
+            cmd, env=_worker_env(self.args, self.local_rank),
+            stdout=stdout, stderr=stderr)
+
+    def poll(self):
+        return self.proc.poll()
+
+    def terminate(self):
+        if self.proc and self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        if self.log:
+            self.log.close()
+            self.log = None
+
+
+def run(argv=None):
+    args = _parse_args(sys.argv[1:] if argv is None else argv)
+    workers = [_Worker(args, lr) for lr in range(args.nproc_per_node)]
+    for w in workers:
+        w.start()
+    try:
+        while True:
+            running = False
+            for w in workers:
+                code = w.poll()
+                if code is None:
+                    running = True
+                elif code != 0:
+                    if w.restarts < args.max_restarts:
+                        w.restarts += 1
+                        print(f"[launch] worker {w.local_rank} exited "
+                              f"{code}; restart "
+                              f"{w.restarts}/{args.max_restarts}",
+                              file=sys.stderr)
+                        w.start()
+                        running = True
+                    else:
+                        print(f"[launch] worker {w.local_rank} failed "
+                              f"with code {code}; stopping all",
+                              file=sys.stderr)
+                        for o in workers:
+                            if o is not w:
+                                o.terminate()
+                        return code
+            if not running:
+                return 0
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        for w in workers:
+            w.terminate()
+        return 130
+    finally:
+        for w in workers:
+            if w.log:
+                w.log.close()
+                w.log = None
+
+
+def launch():
+    sys.exit(run())

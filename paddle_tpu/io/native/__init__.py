@@ -19,13 +19,20 @@ _SO = os.path.join(_DIR, "_ring.so")
 LIB = None
 
 
-def _build():
-    if (os.path.exists(_SO)
+def _build(force=False):
+    if (not force and os.path.exists(_SO)
             and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
         return _SO
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", _SO + ".tmp", _SRC]
-    subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(_SO + ".tmp", _SO)
+    import tempfile
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)  # unique per process:
+    os.close(fd)                                        # concurrent builds
+    try:                                                # publish atomically
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, _SO)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
     return _SO
 
 
@@ -48,6 +55,12 @@ def _bind(path):
 
 try:
     LIB = _bind(_build())
+except OSError:
+    # a cached .so from another arch/OS (copied checkout): rebuild once
+    try:
+        LIB = _bind(_build(force=True))
+    except Exception:  # pragma: no cover - toolchain missing
+        LIB = None
 except Exception:  # pragma: no cover - toolchain missing
     LIB = None
 

@@ -35,9 +35,14 @@ inline char *ring_data(ring_hdr_t *h) {
   return reinterpret_cast<char *>(h) + sizeof(ring_hdr_t);
 }
 
-void ring_backoff() {
-  struct timespec ts = {0, 50 * 1000}; /* 50us */
+/* Exponential backoff: 50us doubling to a 5ms cap, so a briefly-blocked
+ * peer stays responsive while a long-stalled one burns ~200 syscalls/sec
+ * instead of 20k.  Returns the next sleep to use. */
+long ring_backoff(long sleep_us) {
+  struct timespec ts = {0, sleep_us * 1000};
   nanosleep(&ts, nullptr);
+  long next = sleep_us * 2;
+  return next > 5000 ? 5000 : next;
 }
 
 void copy_in(ring_hdr_t *h, uint64_t pos, const char *src, uint64_t len) {
@@ -79,7 +84,7 @@ long ring_write(void *mem, const void *buf, uint64_t len, long timeout_ms) {
   ring_hdr_t *h = static_cast<ring_hdr_t *>(mem);
   uint64_t need = len + 8;
   if (need > h->cap) return -1;
-  long waited_us = 0;
+  long waited_us = 0, sleep_us = 50;
   for (;;) {
     uint64_t head = h->head.load(std::memory_order_relaxed);
     uint64_t tail = h->tail.load(std::memory_order_acquire);
@@ -91,8 +96,8 @@ long ring_write(void *mem, const void *buf, uint64_t len, long timeout_ms) {
       return 0;
     }
     if (timeout_ms >= 0 && waited_us > timeout_ms * 1000) return -2;
-    ring_backoff();
-    waited_us += 50;
+    waited_us += sleep_us;
+    sleep_us = ring_backoff(sleep_us);
   }
 }
 
@@ -100,7 +105,7 @@ long ring_write(void *mem, const void *buf, uint64_t len, long timeout_ms) {
  * >=0 message ready; -1 closed+drained; -2 timeout (try again). */
 long ring_next_len(void *mem, long timeout_ms) {
   ring_hdr_t *h = static_cast<ring_hdr_t *>(mem);
-  long waited_us = 0;
+  long waited_us = 0, sleep_us = 50;
   for (;;) {
     uint64_t tail = h->tail.load(std::memory_order_relaxed);
     uint64_t head = h->head.load(std::memory_order_acquire);
@@ -114,8 +119,8 @@ long ring_next_len(void *mem, long timeout_ms) {
             h->tail.load(std::memory_order_relaxed))
       return -1;
     if (timeout_ms >= 0 && waited_us > timeout_ms * 1000) return -2;
-    ring_backoff();
-    waited_us += 50;
+    waited_us += sleep_us;
+    sleep_us = ring_backoff(sleep_us);
   }
 }
 

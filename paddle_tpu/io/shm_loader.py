@@ -105,7 +105,14 @@ def _to_numpy_tree(obj, device_unsafe):
 
 def _worker_main(ring, worker_id, num_workers, dataset, batch_iter_fn,
                  collate_fn, init_fn, device_unsafe):
-    """Runs in the forked child: produce this worker's batch slice."""
+    """Runs in the forked child: produce this worker's batch slice.
+
+    Returns True on clean completion.  On error, ships an E-message and
+    closes the ring; if even that fails, the ring is left OPEN and False
+    is returned so the child exits nonzero and the parent's dead-worker
+    check fires — a worker must never look 'cleanly finished' after an
+    error (silently truncated epoch).
+    """
     global _WORKER_INFO
     _WORKER_INFO = WorkerInfo(worker_id, num_workers, dataset)
     signal.signal(signal.SIGINT, signal.SIG_IGN)  # parent handles ^C
@@ -115,17 +122,20 @@ def _worker_main(ring, worker_id, num_workers, dataset, batch_iter_fn,
         for samples in batch_iter_fn(worker_id, num_workers):
             batch = _to_numpy_tree(collate_fn(samples), device_unsafe)
             ring.write(b"B" + pickle.dumps(batch, protocol=5))
-    except BaseException as e:
-        try:
-            payload = pickle.dumps((e, traceback.format_exc()))
-        except Exception:  # unpicklable exception: ship the text only
-            payload = pickle.dumps((None, traceback.format_exc()))
-        try:
-            ring.write(b"E" + payload)
-        except Exception:
-            pass
-    finally:
         ring.close_producer()
+        return True
+    except BaseException as e:
+        for payload in (lambda: pickle.dumps((e, traceback.format_exc())),
+                        lambda: pickle.dumps(
+                            (None, f"{type(e).__name__} (unserializable "
+                                   f"error payload)"))):
+            try:
+                ring.write(b"E" + payload(), timeout_ms=10_000)
+                ring.close_producer()
+                return False
+            except Exception:
+                continue
+        return False  # ring left open → parent sees a dead worker
 
 
 class ShmWorkerPool:
@@ -145,10 +155,10 @@ class ShmWorkerPool:
             if pid == 0:  # child
                 code = 1
                 try:
-                    _worker_main(self._rings[w], w, num_workers, dataset,
-                                 batch_iter_fn, collate_fn, worker_init_fn,
-                                 device_unsafe)
-                    code = 0
+                    ok = _worker_main(self._rings[w], w, num_workers,
+                                      dataset, batch_iter_fn, collate_fn,
+                                      worker_init_fn, device_unsafe)
+                    code = 0 if ok else 1
                 finally:
                     os._exit(code)  # skip parent atexit/GC (jax client!)
             self._pids.append(pid)

@@ -1,0 +1,72 @@
+"""paddle_tpu.distributed.launch process runner.
+
+Mirrors the reference's launch tests (test/legacy_test/test_launch_*.py):
+env wiring, multi-process coordination via jax.distributed, elastic
+restart, failure propagation.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.distributed.launch import _parse_args, _worker_env, run
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parse_and_env():
+    args = _parse_args(["--nnodes", "2", "--node_rank", "1",
+                        "--master", "10.0.0.1:1234",
+                        "--nproc_per_node", "2", "train.py", "--lr", "0.1"])
+    assert args.script == "train.py"
+    assert args.script_args == ["--lr", "0.1"]
+    env = _worker_env(args, 1)
+    assert env["PT_COORDINATOR"] == "10.0.0.1:1234"
+    assert env["PT_NUM_PROCESSES"] == "4"
+    assert env["PT_PROCESS_ID"] == "3"
+    assert env["PADDLE_TRAINER_ID"] == "3"
+
+
+def test_two_process_coordination(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {REPO!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import paddle_tpu.distributed as dist
+        dist.init_parallel_env()
+        out = os.path.join({str(tmp_path)!r},
+                           f"rank{{dist.get_rank()}}.txt")
+        with open(out, "w") as f:
+            f.write(f"{{dist.get_rank()}}/{{dist.get_world_size()}}")
+    """))
+    code = run(["--nproc_per_node", "2", "--master", "127.0.0.1:18476",
+                str(script)])
+    assert code == 0
+    assert (tmp_path / "rank0.txt").read_text() == "0/2"
+    assert (tmp_path / "rank1.txt").read_text() == "1/2"
+
+
+def test_elastic_restart(tmp_path):
+    script = tmp_path / "flaky.py"
+    marker = tmp_path / "ran_once"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        m = {str(marker)!r}
+        if not os.path.exists(m):
+            open(m, "w").close()
+            sys.exit(1)   # first attempt fails
+    """))
+    code = run(["--max_restarts", "1", str(script)])
+    assert code == 0
+    assert marker.exists()
+
+
+def test_failure_propagates(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import sys; sys.exit(3)")
+    code = run([str(script)])
+    assert code == 3

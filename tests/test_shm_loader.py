@@ -136,7 +136,11 @@ def test_dead_worker_raises_not_hangs():
 
 
 def test_worker_init_fn_and_info():
-    seen = []
+    import os as _os
+
+    def init_fn(worker_id):
+        # runs in the forked child before any batch; visible to __getitem__
+        _os.environ["_SHM_TEST_INIT"] = str(100 + worker_id)
 
     class Probe(Dataset):
         def __len__(self):
@@ -144,13 +148,17 @@ def test_worker_init_fn_and_info():
 
         def __getitem__(self, i):
             info = get_worker_info()
-            return np.int64(info.id if info else -1)
+            return (np.int64(info.id if info else -1),
+                    np.int64(int(_os.environ.get("_SHM_TEST_INIT", "-1"))))
 
-    out = []
-    for b in DataLoader(Probe(), batch_size=1, num_workers=2):
-        out.extend(np.atleast_1d(b.numpy()).tolist())
+    out, inits = [], []
+    for b in DataLoader(Probe(), batch_size=1, num_workers=2,
+                        worker_init_fn=init_fn):
+        out.extend(np.atleast_1d(b[0].numpy()).tolist())
+        inits.extend(np.atleast_1d(b[1].numpy()).tolist())
     # batches 0,2 from worker 0; 1,3 from worker 1
     assert out == [0, 1, 0, 1]
+    assert inits == [100, 101, 100, 101]  # init_fn ran in each worker
 
 
 def test_device_backed_dataset_falls_back_to_threads():
