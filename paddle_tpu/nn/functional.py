@@ -230,6 +230,60 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
                           data_format=data_format)
 
 
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """Sample x (N,C,H,W) at normalized grid (N,Ho,Wo,2) locations
+    (reference: paddle.nn.functional.grid_sample).  Gathers + lerp on the
+    TPU; out-of-range handling per padding_mode (zeros/border/reflection).
+    """
+    xt, gt = _t(x)._array, _t(grid)._array
+    N, C, H, W = xt.shape
+    gx, gy = gt[..., 0], gt[..., 1]
+
+    def to_px(coord, size):
+        if align_corners:
+            return (coord + 1.0) * 0.5 * (size - 1)
+        return ((coord + 1.0) * size - 1.0) * 0.5
+
+    def reflect(p, size):
+        if size == 1:
+            return jnp.zeros_like(p)
+        span = 2.0 * (size - 1) if align_corners else 2.0 * size
+        low = 0.0 if align_corners else -0.5
+        p = jnp.abs((p - low) % span)
+        p = jnp.where(p > span / 2, span - p, p) + low
+        return p
+
+    px, py = to_px(gx, W), to_px(gy, H)
+    if padding_mode == "reflection":
+        px, py = reflect(px, W), reflect(py, H)
+
+    def gather(ix, iy):
+        """x[n, :, iy, ix] with out-of-range → 0 mask for 'zeros'."""
+        valid = (ix >= 0) & (ix <= W - 1) & (iy >= 0) & (iy <= H - 1)
+        ixc = jnp.clip(ix, 0, W - 1).astype(jnp.int32)
+        iyc = jnp.clip(iy, 0, H - 1).astype(jnp.int32)
+        batch = jnp.arange(N).reshape(N, 1, 1)
+        vals = xt[batch, :, iyc, ixc]          # (N, Ho, Wo, C)
+        if padding_mode == "zeros":
+            vals = vals * valid[..., None].astype(vals.dtype)
+        return vals
+
+    if mode == "nearest":
+        out = gather(jnp.round(px), jnp.round(py))
+    else:  # bilinear
+        x0, y0 = jnp.floor(px), jnp.floor(py)
+        wx, wy = px - x0, py - y0
+        v00 = gather(x0, y0)
+        v01 = gather(x0 + 1, y0)
+        v10 = gather(x0, y0 + 1)
+        v11 = gather(x0 + 1, y0 + 1)
+        wx, wy = wx[..., None], wy[..., None]
+        out = (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+               + v10 * (1 - wx) * wy + v11 * wx * wy)
+    return Tensor._from_array(out.transpose(0, 3, 1, 2))  # → (N,C,Ho,Wo)
+
+
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
     import jax
     k = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else \

@@ -437,3 +437,13 @@ register("uniform_k", lambda key, shape, dtype, min=0.0, max=1.0:
          jax.random.uniform(key, shape, dtype, min, max))
 register("normal_k", lambda key, shape, dtype, mean=0.0, std=1.0:
          jax.random.normal(key, shape, dtype) * std + mean)
+
+
+# ------------------------------------------------------- kv-cache kernels
+@register("dyn_update_seq")
+def dyn_update_seq_k(buf, val, pos):
+    """Write `val` into `buf` at sequence offset `pos` (axis 1) — the
+    preallocated KV-cache update used by the jitted decode loop
+    (reference analog: paddle's fused write_cache_kv in inference)."""
+    return jax.lax.dynamic_update_slice_in_dim(
+        buf, val.astype(buf.dtype), pos.astype(jnp.int32), axis=1)

@@ -492,7 +492,8 @@ for _n in ("cumsum", "gather", "scatter", "sort", "argsort", "topk", "tile",
            "unbind", "tril", "triu", "where", "masked_fill", "index_select",
            "take_along_axis", "put_along_axis", "repeat_interleave", "pad",
            "softmax", "log_softmax", "unique", "nonzero", "masked_select",
-           "allclose", "isclose", "equal_all", "diagonal", "cumprod"):
+           "allclose", "isclose", "equal_all", "diagonal", "cumprod",
+           "kthvalue", "mode", "diff", "as_strided", "matrix_power"):
     # forwarded to the module-level functional API, defined in tensor_api
     def _fwd(self, *args, _n=_n, **kwargs):
         from . import tensor_api

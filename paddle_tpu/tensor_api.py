@@ -606,7 +606,54 @@ def kthvalue(x, k, axis=-1, keepdim=False):
 
 
 def mode(x, axis=-1, keepdim=False):
-    raise NotImplementedError("mode: not yet implemented")
+    """Most frequent value along `axis` (reference: paddle.mode).
+    Ties resolve to the smallest value, index is its last occurrence."""
+    xt = _t(x)
+    arr = jnp.moveaxis(xt._array, axis, -1)
+    # pairwise counts (O(n²) along the axis — fine for the typical use of
+    # mode over class/label dims); smallest-value tie-break via sort order
+    counts = (arr[..., :, None] == arr[..., None, :]).sum(-1)
+    order = jnp.argsort(arr, axis=-1)
+    arr_sorted = jnp.take_along_axis(arr, order, axis=-1)
+    counts_sorted = jnp.take_along_axis(counts, order, axis=-1)
+    pos = jnp.argmax(counts_sorted, axis=-1)
+    values = jnp.take_along_axis(arr_sorted, pos[..., None], -1)[..., 0]
+    # index of the LAST occurrence of the mode value in the original order
+    is_mode = arr == values[..., None]
+    n = arr.shape[-1]
+    idx = jnp.max(jnp.where(is_mode, jnp.arange(n), -1), axis=-1)
+    if keepdim:
+        values, idx = values[..., None], idx[..., None]
+        values = jnp.moveaxis(values, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return Tensor._from_array(values), Tensor._from_array(idx)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    xt = _t(x)
+    return Tensor._from_array(jnp.diff(
+        xt._array, n=n, axis=axis,
+        prepend=None if prepend is None else _t(prepend)._array,
+        append=None if append is None else _t(append)._array))
+
+
+def as_strided(x, shape, stride, offset=0):
+    """paddle.as_strided semantics via gather (XLA has no strided views):
+    index = offset + Σ stride_k · i_k over the flattened input."""
+    xt = _t(x)
+    flat = xt._array.reshape(-1)
+    idx = jnp.asarray(offset, jnp.int32)
+    for k, (s, st) in enumerate(zip(shape, stride)):
+        ax_idx = jnp.arange(s, dtype=jnp.int32) * builtins.int(st)
+        expand = [None] * len(shape)
+        expand[k] = slice(None)
+        idx = idx + ax_idx[tuple(expand)]
+    return Tensor._from_array(jnp.take(flat, idx))
+
+
+def matrix_power(x, n):
+    return Tensor._from_array(
+        jnp.linalg.matrix_power(_t(x)._array, builtins.int(n)))
 
 
 def trace(x, offset=0, axis1=0, axis2=1):

@@ -1,0 +1,144 @@
+"""Fully-jitted autoregressive decoding (reference analog: the reference's
+dy2static + fused inference graph for generation — ERNIE/GPT inference via
+CINN; here the ENTIRE decode loop, prefill + lax.while_loop over tokens,
+is ONE XLA program, so a 100-token generation costs one dispatch instead
+of 100 host round-trips).
+
+Models opt in by supporting the preallocated KV cache: a cache dict
+{"k": [b, max_len, H, D], "v": ..., "pos": int32 scalar} whose sequence
+slot is written at the traced offset (ops "dyn_update_seq") and whose
+attention is masked to `col <= pos + row` — static shapes throughout,
+which is what lets XLA compile the loop.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..autograd import engine
+from ..jit import functional_bridge as FB
+from ..tensor import Tensor
+
+
+def _update_prealloc_cache(cache, k, v, s):
+    """Write k/v at cache['pos'] and return full buffers + bool attn mask."""
+    from .. import tensor_api as T
+    from ..ops import call as ops_call
+    pos = cache["pos"]
+    cache["k"] = ops_call("dyn_update_seq", cache["k"], k, pos)
+    cache["v"] = ops_call("dyn_update_seq", cache["v"], v, pos)
+    K, V = cache["k"], cache["v"]
+    L = K.shape[1]
+    cols = T.arange(L, dtype="int32").unsqueeze(0)          # [1, L]
+    rows = (pos.astype("int32") + T.arange(s, dtype="int32")).unsqueeze(1)
+    mask = (cols <= rows).reshape([1, 1, s, L])
+    return K, V, mask
+
+
+def _sample(logits, key, do_sample, temperature, top_k, top_p):
+    from .generation import filter_logits
+    logits = logits.astype(jnp.float32)
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1)
+    return jax.random.categorical(
+        key, filter_logits(logits, temperature, top_k, top_p), axis=-1)
+
+
+def _model_step(model, pn, bn, p_arrays, b_arrays, ids, cache_arrays, pos):
+    """One functional forward over the preallocated caches."""
+    caches = [{"k": Tensor._from_array(ck), "v": Tensor._from_array(cv),
+               "pos": Tensor._from_array(pos)}
+              for ck, cv in cache_arrays]
+    with FB._swapped(model, pn, p_arrays, bn, b_arrays):
+        with engine.no_grad():
+            logits = model(Tensor._from_array(ids), caches=caches)
+    new_cache_arrays = [(c["k"]._array, c["v"]._array) for c in caches]
+    return logits._array, new_cache_arrays
+
+
+def jit_generate(model, input_ids, max_new_tokens=20, do_sample=False,
+                 temperature=1.0, top_k=None, top_p=None, eos_token_id=None,
+                 seed_key=None):
+    """Compile prefill + decode into one XLA program; returns
+    [b, prompt + max_new_tokens] ids (positions after eos hold eos)."""
+    from ..framework import random as _random
+    was_training = model.training
+    model.eval()
+    try:
+        pn, p_arrays, bn, b_arrays = FB.split_state(model)
+        b, prompt_len = input_ids.shape
+        total = prompt_len + max_new_tokens
+        dtype = p_arrays[0].dtype
+        proto = model.new_caches(b, dtype=dtype, max_length=total)
+        cache_arrays = [(c["k"]._array, c["v"]._array) for c in proto]
+        key = seed_key if seed_key is not None else _random.next_key()
+
+        cache_key = (prompt_len, max_new_tokens, bool(do_sample),
+                     float(temperature), top_k, top_p, eos_token_id, b)
+        cache = model.__dict__.setdefault("_jit_decode_cache", {})
+        fn = cache.pop(cache_key, None)  # re-insert below → LRU order
+        if fn is None:
+            def pure(p_arrays, b_arrays, ids, cache_arrays, key):
+                ids = ids.astype(jnp.int32)
+                logits, cache_arrays = _model_step(
+                    model, pn, bn, p_arrays, b_arrays, ids, cache_arrays,
+                    jnp.asarray(0, jnp.int32))
+                key, sub = jax.random.split(key)
+                nxt = _sample(logits[:, -1, :], sub, do_sample, temperature,
+                              top_k, top_p).astype(jnp.int32)
+                # eos-fill so rows that finish early read as eos-padded even
+                # when the whole loop exits before writing the tail
+                fill = eos_token_id if eos_token_id is not None else 0
+                buf = jnp.full((b, total), fill, jnp.int32)
+                buf = lax.dynamic_update_slice(buf, ids, (0, 0))
+                buf = buf.at[:, prompt_len].set(nxt)
+                finished = jnp.zeros((b,), bool) if eos_token_id is not None \
+                    else None
+                if finished is not None:
+                    finished = finished | (nxt == eos_token_id)
+
+                def cond(state):
+                    i, _, _, _, fin = state
+                    alive = jnp.asarray(True) if fin is None else ~fin.all()
+                    return (i < total) & alive
+
+                def body(state):
+                    i, buf, cache_arrays, key, fin = state
+                    cur = lax.dynamic_slice(buf, (0, i - 1), (b, 1))
+                    logits, cache_arrays = _model_step(
+                        model, pn, bn, p_arrays, b_arrays, cur,
+                        cache_arrays, i - 1)
+                    key, sub = jax.random.split(key)
+                    nxt = _sample(logits[:, -1, :], sub, do_sample,
+                                  temperature, top_k, top_p).astype(jnp.int32)
+                    if fin is not None:
+                        nxt = jnp.where(fin, eos_token_id, nxt)
+                        fin = fin | (nxt == eos_token_id)
+                    buf = lax.dynamic_update_slice(buf, nxt[:, None], (0, i))
+                    return (i + 1, buf, cache_arrays, key, fin)
+
+                state = (jnp.asarray(prompt_len + 1, jnp.int32), buf,
+                         cache_arrays, key, finished)
+                _, buf, _, _, _ = lax.while_loop(cond, body, state)
+                return buf
+
+            fn = jax.jit(pure)
+        cache[cache_key] = fn
+        while len(cache) > 8:  # LRU: varying prompt shapes would otherwise
+            cache.pop(next(iter(cache)))  # retain every compiled program
+
+        out = fn(p_arrays, b_arrays, input_ids._array, cache_arrays, key)
+        if eos_token_id is not None:
+            # match the eager loop's early-exit shape: truncate after the
+            # last row finishes (positions past a row's eos are eos-padded)
+            import numpy as np
+            host = np.asarray(out)
+            gen = host[:, prompt_len:]
+            hit = gen == eos_token_id
+            first = np.where(hit.any(1), hit.argmax(1), gen.shape[1] - 1)
+            out = host[:, :prompt_len + int(first.max()) + 1]
+        return Tensor._from_array(jnp.asarray(out))
+    finally:
+        if was_training:
+            model.train()

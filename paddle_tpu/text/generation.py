@@ -11,9 +11,9 @@ from ..framework import random as _random
 from ..tensor import Tensor
 
 
-def _sample_next(logits, temperature, top_k, top_p, greedy):
-    if greedy:
-        return jnp.argmax(logits, axis=-1)
+def filter_logits(logits, temperature, top_k, top_p):
+    """Temperature / top-k / nucleus filtering — the ONE implementation
+    shared by the eager loop here and the jitted loop in decode.py."""
     logits = logits / jnp.maximum(temperature, 1e-6)
     if top_k is not None and top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
@@ -25,6 +25,13 @@ def _sample_next(logits, temperature, top_k, top_p, greedy):
         cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
+def _sample_next(logits, temperature, top_k, top_p, greedy):
+    if greedy:
+        return jnp.argmax(logits, axis=-1)
+    logits = filter_logits(logits, temperature, top_k, top_p)
     return jax.random.categorical(_random.next_key(), logits, axis=-1)
 
 

@@ -19,6 +19,7 @@ from ..distributed.parallel_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
 )
 from ..distributed.recompute import recompute
+from .decode import _update_prealloc_cache
 
 
 class GPTConfig:
@@ -88,7 +89,14 @@ class GPTAttention(nn.Layer):
         qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads,
                                         self.head_dim])
         q, k, v = qkv.unbind(axis=2)
-        if cache is not None:
+        if cache is not None and "pos" in cache:
+            # preallocated cache (jitted decode): static shapes, write at
+            # the traced offset, attend under a length mask
+            k, v, mask = _update_prealloc_cache(cache, k, v, s)
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=mask, training=self.training,
+                dropout_p=0.0)
+        elif cache is not None:
             k = T.concat([cache["k"], k], axis=1)
             v = T.concat([cache["v"], v], axis=1)
             cache["k"], cache["v"] = k, v
@@ -158,11 +166,18 @@ class GPTModel(nn.Layer):
         from .. import tensor_api as T
         b, s = input_ids.shape
         if position_ids is None:
-            offset = 0
-            if caches is not None and caches[0] is not None:
-                offset = caches[0]["k"].shape[1]
-            position_ids = T.arange(offset, offset + s, dtype="int64")
-            position_ids = position_ids.unsqueeze(0)
+            if caches is not None and caches[0] is not None \
+                    and "pos" in caches[0]:
+                # preallocated cache: offset is a traced scalar
+                position_ids = (T.arange(0, s, dtype="int32")
+                                + caches[0]["pos"].astype("int32"))
+                position_ids = position_ids.unsqueeze(0)
+            else:
+                offset = 0
+                if caches is not None and caches[0] is not None:
+                    offset = caches[0]["k"].shape[1]
+                position_ids = T.arange(offset, offset + s, dtype="int64")
+                position_ids = position_ids.unsqueeze(0)
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
         for i, block in enumerate(self.h):
@@ -188,20 +203,28 @@ class GPTForCausalLM(nn.Layer):
         logits = x.matmul(self.gpt.wte.weight, transpose_y=True)
         return logits
 
-    def new_caches(self, batch_size, dtype="float32"):
+    def new_caches(self, batch_size, dtype="float32", max_length=None):
+        """Concat-style caches (eager decode) or, with `max_length`, the
+        preallocated static-shape caches the jitted decode loop uses."""
         from .. import tensor_api as T
+        hd = self.cfg.hidden_size // self.cfg.num_heads
+        L = 0 if max_length is None else max_length
         caches = []
         for _ in range(self.cfg.num_layers):
-            caches.append({
-                "k": T.zeros([batch_size, 0, self.cfg.num_heads,
-                              self.cfg.hidden_size // self.cfg.num_heads],
-                             dtype=dtype),
-                "v": T.zeros([batch_size, 0, self.cfg.num_heads,
-                              self.cfg.hidden_size // self.cfg.num_heads],
-                             dtype=dtype)})
+            c = {"k": T.zeros([batch_size, L, self.cfg.num_heads, hd],
+                              dtype=dtype),
+                 "v": T.zeros([batch_size, L, self.cfg.num_heads, hd],
+                              dtype=dtype)}
+            if max_length is not None:
+                c["pos"] = T.zeros([], dtype="int32")
+            caches.append(c)
         return caches
 
-    def generate(self, input_ids, max_new_tokens=20, **kw):
+    def generate(self, input_ids, max_new_tokens=20, use_jit=True, **kw):
+        if use_jit:
+            from .decode import jit_generate
+            return jit_generate(self, input_ids,
+                                max_new_tokens=max_new_tokens, **kw)
         from .generation import generate
         return generate(self, input_ids, max_new_tokens=max_new_tokens, **kw)
 

@@ -103,17 +103,29 @@ class LlamaAttention(nn.Layer):
         k = self.k_proj(x).reshape([b, s, cfg.num_kv_heads, self.head_dim])
         v = self.v_proj(x).reshape([b, s, cfg.num_kv_heads, self.head_dim])
 
-        offset = 0
-        if cache is not None:
-            offset = cache["k"].shape[1]
+        prealloc = cache is not None and "pos" in cache
+        if prealloc:
+            def rope_fn(qa, ka, pa, theta=cfg.rope_theta):
+                pos = (pa.astype(jnp.int32)
+                       + jnp.arange(qa.shape[1]))[None, :]
+                return _rope(qa, ka, pos, theta)
+            q, k = engine.apply("rope", rope_fn, [q, k, cache["pos"]])
+        else:
+            offset = 0
+            if cache is not None:
+                offset = cache["k"].shape[1]
 
-        def rope_fn(qa, ka, offset=offset, theta=cfg.rope_theta):
-            pos = (offset + jnp.arange(qa.shape[1]))[None, :]
-            return _rope(qa, ka, pos, theta)
+            def rope_fn(qa, ka, offset=offset, theta=cfg.rope_theta):
+                pos = (offset + jnp.arange(qa.shape[1]))[None, :]
+                return _rope(qa, ka, pos, theta)
 
-        q, k = engine.apply("rope", rope_fn, [q, k])
+            q, k = engine.apply("rope", rope_fn, [q, k])
 
-        if cache is not None:
+        mask = None
+        if prealloc:
+            from .decode import _update_prealloc_cache
+            k, v, mask = _update_prealloc_cache(cache, k, v, s)
+        elif cache is not None:
             k = T.concat([cache["k"], k], axis=1)
             v = T.concat([cache["v"], v], axis=1)
             cache["k"], cache["v"] = k, v
@@ -121,9 +133,14 @@ class LlamaAttention(nn.Layer):
         if rep > 1:
             k = k.repeat_interleave(rep, axis=2)
             v = v.repeat_interleave(rep, axis=2)
-        out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=(cache is None or s > 1), dropout_p=0.0,
-            training=self.training)
+        if prealloc:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=mask, dropout_p=0.0,
+                training=self.training)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=(cache is None or s > 1), dropout_p=0.0,
+                training=self.training)
         return self.o_proj(out.reshape([b, s, -1]))
 
 
@@ -192,15 +209,25 @@ class LlamaForCausalLM(nn.Layer):
         x = self.llama(input_ids, caches)
         return self.lm_head(x)
 
-    def new_caches(self, batch_size, dtype="float32"):
+    def new_caches(self, batch_size, dtype="float32", max_length=None):
         from .. import tensor_api as T
         hd = self.cfg.hidden_size // self.cfg.num_heads
-        return [{"k": T.zeros([batch_size, 0, self.cfg.num_kv_heads, hd],
+        L = 0 if max_length is None else max_length
+        caches = []
+        for _ in range(self.cfg.num_layers):
+            c = {"k": T.zeros([batch_size, L, self.cfg.num_kv_heads, hd],
                               dtype=dtype),
-                 "v": T.zeros([batch_size, 0, self.cfg.num_kv_heads, hd],
+                 "v": T.zeros([batch_size, L, self.cfg.num_kv_heads, hd],
                               dtype=dtype)}
-                for _ in range(self.cfg.num_layers)]
+            if max_length is not None:
+                c["pos"] = T.zeros([], dtype="int32")
+            caches.append(c)
+        return caches
 
-    def generate(self, input_ids, max_new_tokens=20, **kw):
+    def generate(self, input_ids, max_new_tokens=20, use_jit=True, **kw):
+        if use_jit:
+            from .decode import jit_generate
+            return jit_generate(self, input_ids,
+                                max_new_tokens=max_new_tokens, **kw)
         from .generation import generate
         return generate(self, input_ids, max_new_tokens=max_new_tokens, **kw)

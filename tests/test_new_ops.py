@@ -1,0 +1,76 @@
+"""New op coverage: kthvalue/mode/diff/as_strided/matrix_power/grid_sample.
+
+Numeric references come from torch-cpu (same convention as the reference's
+per-op tests, SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+
+
+def test_kthvalue_method():
+    x = pt.to_tensor([[3.0, 1.0, 2.0], [9.0, 7.0, 8.0]])
+    v, i = x.kthvalue(2, axis=1)
+    np.testing.assert_allclose(v.numpy(), [2.0, 8.0])
+    np.testing.assert_array_equal(i.numpy(), [2, 2])
+
+
+def test_mode():
+    x = pt.to_tensor([[1.0, 2.0, 2.0, 3.0], [4.0, 4.0, 5.0, 4.0]])
+    v, i = pt.mode(x, axis=-1)
+    tv, ti = torch.mode(torch.tensor(x.numpy()), dim=-1)
+    np.testing.assert_allclose(v.numpy(), tv.numpy())
+    # indices: both frameworks point at an occurrence of the mode value
+    np.testing.assert_allclose(
+        np.take_along_axis(x.numpy(), i.numpy()[:, None], 1)[:, 0],
+        tv.numpy())
+
+
+def test_mode_method_and_keepdim():
+    x = pt.to_tensor([1.0, 1.0, 7.0])
+    v, i = x.mode(keepdim=True)
+    assert v.shape == [1]
+    np.testing.assert_allclose(v.numpy(), [1.0])
+
+
+def test_diff():
+    x = pt.to_tensor([1.0, 4.0, 9.0, 16.0])
+    np.testing.assert_allclose(pt.diff(x).numpy(), [3.0, 5.0, 7.0])
+    np.testing.assert_allclose(pt.diff(x, n=2).numpy(), [2.0, 2.0])
+    np.testing.assert_allclose(
+        pt.diff(x, prepend=pt.to_tensor([0.0])).numpy(), [1.0, 3.0, 5.0, 7.0])
+
+
+def test_as_strided():
+    x = pt.arange(6).astype("float32")
+    y = pt.as_strided(x, [2, 3], [3, 1])
+    np.testing.assert_allclose(y.numpy(), [[0, 1, 2], [3, 4, 5]])
+    # overlapping windows
+    z = pt.as_strided(x, [4, 3], [1, 1])
+    t = torch.as_strided(torch.arange(6.0), (4, 3), (1, 1))
+    np.testing.assert_allclose(z.numpy(), t.numpy())
+
+
+def test_matrix_power():
+    x = pt.to_tensor([[2.0, 0.0], [0.0, 3.0]])
+    np.testing.assert_allclose(x.matrix_power(3).numpy(),
+                               [[8.0, 0.0], [0.0, 27.0]])
+
+
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+@pytest.mark.parametrize("padding_mode", ["zeros", "border", "reflection"])
+@pytest.mark.parametrize("align_corners", [True, False])
+def test_grid_sample_vs_torch(mode, padding_mode, align_corners):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 3, 5, 7)).astype(np.float32)
+    grid = (rng.uniform(-1.3, 1.3, (2, 4, 6, 2))).astype(np.float32)
+    got = F.grid_sample(pt.to_tensor(x), pt.to_tensor(grid), mode=mode,
+                        padding_mode=padding_mode,
+                        align_corners=align_corners).numpy()
+    want = torch.nn.functional.grid_sample(
+        torch.tensor(x), torch.tensor(grid), mode=mode,
+        padding_mode=padding_mode, align_corners=align_corners).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
