@@ -19,11 +19,23 @@ _tls = threading.local()
 
 
 class _AmpState:
-    __slots__ = ("dtype", "level")
+    __slots__ = ("dtype", "level", "white", "black")
 
-    def __init__(self, dtype, level):
+    def __init__(self, dtype, level, white=(), black=()):
         self.dtype = dtype
         self.level = level
+        self.white = frozenset(white or ())
+        self.black = frozenset(black or ())
+
+    def policy_for(self, op_name, default):
+        """Reference semantics (paddle/amp/auto_cast.py): custom lists move
+        an op between the allow ("white") and deny ("black") sets; black
+        wins over white on conflict, like the reference's check."""
+        if op_name in self.black:
+            return "deny"
+        if op_name in self.white:
+            return "allow"
+        return default
 
 
 def amp_state():
@@ -36,7 +48,8 @@ def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
     """paddle.amp.auto_cast equivalent."""
     prev = amp_state()
     if enable:
-        _tls.state = _AmpState(dtypes.convert_dtype(dtype), level)
+        _tls.state = _AmpState(dtypes.convert_dtype(dtype), level,
+                               custom_white_list, custom_black_list)
     else:
         _tls.state = None
     try:

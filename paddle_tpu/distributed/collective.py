@@ -38,19 +38,85 @@ def _axis(group):
     return getattr(group, "axis_name", group if isinstance(group, str) else "dp")
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=4)
+def _process_mesh():
+    """One-axis mesh over every device of every launch process (cached —
+    the device list is fixed for process lifetime)."""
+    import numpy as np
+    from jax.sharding import Mesh
+    return Mesh(np.asarray(jax.devices()), ("px",))
+
+
+# module-level reduce bodies: stable identities so jax.jit's compilation
+# cache hits across eager collective calls. Every local device holds a
+# replica, so ops reduce over one shard per PROCESS (x[::n_local]) —
+# dtype-preserving (no float promotion for int SUM).
+def _red_sum(x, n_local):
+    return jnp.sum(x[::n_local], axis=0)
+
+
+def _red_max(x, n_local):
+    return jnp.max(x[::n_local], axis=0)
+
+
+def _red_min(x, n_local):
+    return jnp.min(x[::n_local], axis=0)
+
+
+def _red_avg(x, n_local):
+    return jnp.mean(x[::n_local], axis=0)
+
+
+def _red_stack(x, n_local):
+    return x
+
+
+_MP_REDUCERS = {ReduceOp.SUM: _red_sum, ReduceOp.MAX: _red_max,
+                ReduceOp.MIN: _red_min, ReduceOp.AVG: _red_avg,
+                "stack": _red_stack}
+
+
+@functools.lru_cache(maxsize=16)
+def _mp_jitted(op):
+    from jax.sharding import NamedSharding, PartitionSpec
+    mesh = _process_mesh()
+    fn = _MP_REDUCERS[op]
+    return jax.jit(functools.partial(fn, n_local=jax.local_device_count()),
+                   out_shardings=NamedSharding(mesh, PartitionSpec()))
+
+
+def _mp_collective(arr, op):
+    """Eager cross-process collective: stack each process's value as a
+    shard of a global array, reduce under jit, read back the replicated
+    result.  This is what makes the eager API real across
+    `distributed.launch` processes (reference: ProcessGroupNCCL eager
+    mode; here XLA's cross-host collectives do the transport)."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+    mesh = _process_mesh()
+    n_local = jax.local_device_count()
+    local = np.broadcast_to(np.asarray(arr)[None],
+                            (n_local,) + np.asarray(arr).shape)
+    sh = NamedSharding(mesh, PartitionSpec("px"))
+    g = jax.make_array_from_process_local_data(sh, local)
+    return jnp.asarray(_mp_jitted(op)(g))
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     axis = _axis(group)
-    if isinstance(tensor, Tensor):
-        try:
-            fn = {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax,
-                  ReduceOp.MIN: lax.pmin,
-                  ReduceOp.AVG: lax.pmean}[op]
-            tensor._array = fn(tensor._array, axis)
-        except NameError:
-            pass  # eager single-process: identity
-        return tensor
     fn = {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax,
           ReduceOp.MIN: lax.pmin, ReduceOp.AVG: lax.pmean}[op]
+    if isinstance(tensor, Tensor):
+        try:
+            tensor._array = fn(tensor._array, axis)
+        except NameError:
+            if jax.process_count() > 1:
+                tensor._array = _mp_collective(tensor._array, op)
+            # single process: identity
+        return tensor
     return fn(tensor, axis)
 
 
@@ -66,10 +132,17 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
             return tensor_list
         return gathered
     except NameError:
+        if jax.process_count() > 1:
+            n_local = jax.local_device_count()
+            stacked = _mp_collective(arr, "stack")  # [world*n_local, ...]
+            gathered = stacked[::n_local]           # one per process
+        else:
+            gathered = jnp.asarray(arr)[None]
         if tensor_list is not None:
-            tensor_list.append(tensor)
+            tensor_list.extend(Tensor._from_array(gathered[i])
+                               for i in range(gathered.shape[0]))
             return tensor_list
-        return arr[None]
+        return gathered
 
 
 def reduce_scatter(output, input_list_or_tensor, op=ReduceOp.SUM, group=None):
@@ -87,7 +160,12 @@ def reduce_scatter(output, input_list_or_tensor, op=ReduceOp.SUM, group=None):
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    # single-controller: all replicas already share the value
+    if jax.process_count() > 1 and isinstance(tensor, Tensor):
+        n_local = jax.local_device_count()
+        stacked = _mp_collective(tensor._array, "stack")
+        tensor._array = stacked[src * n_local]
+        return tensor
+    # single controller: all replicas already share the value
     return tensor
 
 

@@ -19,10 +19,12 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..autograd import engine as _engine
 from ..jit import functional_bridge as FB
 from ..framework import random as _random
 from ..tensor import Tensor
 from . import mesh as mesh_mod
+from .pipeline import pipeline_apply_hybrid
 
 
 def _largest_divisible_axis(shape, degree, taken=()):
@@ -65,6 +67,22 @@ def state_pspec(p_spec, shape, stage):
     return P(*spec)
 
 
+class _PipelineShim:
+    """Stands in for the model inside the traced loss_fn when pp>1: calling
+    it runs pre → GPipe shard_map over the pp axis → post, so unmodified
+    loss_fns (e.g. gpt_loss_fn) transparently get a pipelined forward."""
+
+    def __init__(self, model, run_pipeline):
+        object.__setattr__(self, "_pt_model", model)
+        object.__setattr__(self, "_pt_run", run_pipeline)
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_pt_model"), name)
+
+    def __call__(self, *args, **kwargs):
+        return object.__getattribute__(self, "_pt_run")(*args, **kwargs)
+
+
 class DistributedTrainStep:
     """Fused hybrid-parallel train step over the global mesh."""
 
@@ -75,6 +93,7 @@ class DistributedTrainStep:
         self.optimizer = optimizer
         self.strategy = strategy
         self.sharding_stage = 0
+        hc = {}
         if strategy is not None:
             hc = strategy.hybrid_configs
             self.sharding_stage = int(hc.get("sharding_stage", 0) or 0)
@@ -82,25 +101,141 @@ class DistributedTrainStep:
                     int(hc.get("sharding_degree", 1)) > 1 and \
                     self.sharding_stage == 0:
                 self.sharding_stage = 1
+        self.pp = mesh_mod.degree("pp")
+        self.use_pp = self.pp > 1
+        if self.use_pp and not hasattr(model, "pipeline_decompose"):
+            raise ValueError(
+                "pp_degree > 1 requires the model to implement "
+                "pipeline_decompose() (blocks/pre/post stage plan)")
+        pc = getattr(strategy, "pipeline_configs", None) or {}
+        self.n_microbatches = int(
+            pc.get("accumulate_steps") if int(pc.get(
+                "accumulate_steps", 1) or 1) > 1
+            else hc.get("accumulate_steps") or self.pp)
+        self._pp_state = None  # (outer_named, blocks, leaf_names, decomp)
+        self._stacked = None   # {leaf_name: [pp, L/pp, ...] array}
+        self._model_stale = False
         self._jitted = None
         self._opt_state = None
         self._step = 0
         self._placed = False
 
+    # --------------------------------------------------------- pp splitting
+    def _pp_split(self):
+        """Split params into non-block ("outer") and stacked block leaves."""
+        if self._pp_state is not None:
+            return self._pp_state
+        decomp = self.model.pipeline_decompose()
+        blocks = decomp["blocks"]
+        if len(blocks) % self.pp != 0:
+            raise ValueError(
+                f"{len(blocks)} pipeline blocks do not divide into "
+                f"pp_degree={self.pp} stages")
+        for b in blocks:
+            if list(b.named_buffers()):
+                raise ValueError(
+                    "pipelined blocks with buffers (BatchNorm-style running "
+                    "stats) are not supported; keep them outside the blocks")
+        block_ids = {id(p) for b in blocks for _, p in b.named_parameters()}
+        outer_named = [(n, p) for n, p in self.model.named_parameters()
+                       if id(p) not in block_ids]
+        leaf_names = [n for n, _ in blocks[0].named_parameters()]
+        self._pp_state = (outer_named, blocks, leaf_names, decomp)
+        return self._pp_state
+
+    def _stacked_specs(self, blocks, leaf_names):
+        """PartitionSpec per stacked leaf: P("pp", None, *block_pspec), plus
+        a "dp" axis on the largest free dim when ZeRO stage 3."""
+        specs = {}
+        b0 = dict(blocks[0].named_parameters())
+        for ln in leaf_names:
+            p = b0[ln]
+            base = list(p.pspec) if p.pspec is not None \
+                else [None] * p._array.ndim
+            while len(base) < p._array.ndim:
+                base.append(None)
+            spec = ["pp", None] + base
+            if self.sharding_stage >= 3:
+                shape = (self.pp, len(blocks) // self.pp) + p._array.shape
+                taken = tuple(i for i, s in enumerate(spec) if s is not None)
+                ax = _largest_divisible_axis(shape, mesh_mod.degree("dp"),
+                                             taken)
+                if ax is not None:
+                    spec[ax] = "dp"
+            specs[ln] = P(*spec)
+        return specs
+
+    def _stack_blocks(self, blocks, leaf_names):
+        """Stack per-block params into [pp, layers_per_stage, ...] leaves."""
+        pp = self.pp
+        lps = len(blocks) // pp
+        mesh = mesh_mod.get_mesh()
+        specs = self._stacked_specs(blocks, leaf_names)
+        block_params = [dict(b.named_parameters()) for b in blocks]
+        stacked = {}
+        for ln in leaf_names:
+            arrs = [bp[ln]._array for bp in block_params]
+            leaf = jnp.stack(arrs).reshape((pp, lps) + arrs[0].shape)
+            stacked[ln] = jax.device_put(
+                leaf, NamedSharding(mesh, specs[ln]))
+        return stacked, specs
+
+    def sync_model(self):
+        """Scatter the stacked block leaves back into the eager model's
+        per-block parameters (needed before state_dict/checkpoint save).
+        Clears the auto-sync hook afterwards so a later training phase
+        (eager, or another engine) can't be clobbered by this engine's
+        by-then-stale stacked copy."""
+        if getattr(self.model, "_pp_sync", None) == self.sync_model:
+            self.model._pp_sync = None
+        if not self.use_pp or self._stacked is None or not self._model_stale:
+            return
+        outer_named, blocks, leaf_names, _ = self._pp_split()
+        block_params = [dict(b.named_parameters()) for b in blocks]
+        for ln in leaf_names:
+            leaf = self._stacked[ln]
+            flat = leaf.reshape((len(blocks),) + leaf.shape[2:])
+            for i, bp in enumerate(block_params):
+                bp[ln]._inplace_assign(flat[i])
+        self._model_stale = False
+
     # ------------------------------------------------------------ shardings
     def _shardings(self):
         mesh = mesh_mod.get_mesh()
         stage = self.sharding_stage
-        params = list(dict(self.model.named_parameters()).values())
+        if self.use_pp:
+            outer_named, _, _, _ = self._pp_split()
+            params = [p for _, p in outer_named]
+        else:
+            params = list(dict(self.model.named_parameters()).values())
         p_specs = [param_pspec(p, stage) for p in params]
         p_sh = [NamedSharding(mesh, s) for s in p_specs]
         b_sh = [NamedSharding(mesh, P())
                 for _ in dict(self.model.named_buffers())]
         return params, p_specs, p_sh, b_sh
 
+    def _flat_param_arrays(self):
+        """Training-state arrays in optimizer order: outer params, then (pp)
+        the stacked block leaves."""
+        params, p_specs, _, _ = self._shardings()
+        arrays = [p._array for p in params]
+        specs = list(p_specs)
+        if self.use_pp:
+            outer_named, blocks, leaf_names, _ = self._pp_split()
+            st_specs = self._stacked_specs(blocks, leaf_names)
+            for ln in leaf_names:
+                arrays.append(self._stacked[ln])
+                specs.append(st_specs[ln])
+        return arrays, specs
+
     def _place_state(self):
         """Device_put params/buffers/opt state with their target shardings
         once, so the jitted step never re-lays-out."""
+        # adopt the model: flush any previous pp engine's pending sync so
+        # we start from the latest weights, and take over the hook
+        prev_sync = getattr(self.model, "_pp_sync", None)
+        if prev_sync is not None and prev_sync != self.sync_model:
+            prev_sync()
         params, p_specs, p_sh, b_sh = self._shardings()
         for p, sh in zip(params, p_sh):
             p._inplace_assign(jax.device_put(p._array, sh))
@@ -108,11 +243,30 @@ class DistributedTrainStep:
         for b, sh in zip(buffers, b_sh):
             b._inplace_assign(jax.device_put(b._array, sh))
         mesh = mesh_mod.get_mesh()
+        if self.use_pp and self._stacked is None:
+            outer_named, blocks, leaf_names, _ = self._pp_split()
+            self._stacked, _ = self._stack_blocks(blocks, leaf_names)
+            # fleet-order bookkeeping (outer params, then stacked leaves) —
+            # kept on the engine and passed into optimizer.update() so the
+            # optimizer's own parameter lists stay untouched.  A stacked
+            # leaf is represented by its block-0 param: full model name
+            # (so user apply_decay_param_fun predicates keep working) and
+            # param group.
+            full_by_id = {id(p): n for n, p in self.model.named_parameters()}
+            gmap = getattr(self.optimizer, "_group_by_id", {})
+            b0 = dict(blocks[0].named_parameters())
+            flat_ps = [p for _, p in outer_named] + \
+                [b0[ln] for ln in leaf_names]
+            self._fleet_param_names = [full_by_id[id(p)] for p in flat_ps]
+            self._fleet_lr_scales = [
+                gmap.get(id(p), (1.0, None))[0] for p in flat_ps]
+            self._fleet_wd_overrides = [
+                gmap.get(id(p), (1.0, None))[1] for p in flat_ps]
+        arrays, flat_specs = self._flat_param_arrays()
         if self._opt_state is None:
-            self._opt_state = self.optimizer.init_state(
-                [p._array for p in params])
+            self._opt_state = self.optimizer.init_state(arrays)
         placed_state = []
-        for slots, spec in zip(self._opt_state, p_specs):
+        for slots, spec in zip(self._opt_state, flat_specs):
             placed = {}
             for name, arr in slots.items():
                 sh = NamedSharding(mesh, state_pspec(spec, arr.shape,
@@ -122,40 +276,162 @@ class DistributedTrainStep:
         self._opt_state = placed_state
         self._placed = True
 
+    # ------------------------------------------------------- multi-process
+    def _globalize_batch(self, batch_arrays):
+        """Multi-controller dp: each launch process feeds its LOCAL batch;
+        assemble the global dp-sharded jax.Array from the per-process
+        shards (reference analog: DistributedBatchSampler feeding each
+        NCCL rank its slice — here the slices become one global array)."""
+        if jax.process_count() == 1:
+            return batch_arrays
+        import numpy as np
+        mesh = mesh_mod.get_mesh()
+        out = []
+        for a in batch_arrays:
+            if a.ndim == 0:
+                out.append(a)
+                continue
+            spec = P(*(["dp"] + [None] * (a.ndim - 1)))
+            out.append(jax.make_array_from_process_local_data(
+                NamedSharding(mesh, spec), np.asarray(a)))
+        return tuple(out)
+
     # ----------------------------------------------------------------- step
+    def _make_run_pipeline(self, stacked, rng):
+        """Closure the shim calls in place of model.__call__: pre → GPipe
+        shard_map over "pp" (dp/mp left to GSPMD inside) → post."""
+        outer_named, blocks, leaf_names, decomp = self._pp_split()
+        mesh = mesh_mod.get_mesh()
+        template = blocks[0]
+        M = self.n_microbatches
+        remat = bool(decomp.get("remat", False))
+
+        def block_apply(leaf_dict, h, key):
+            arrs = [leaf_dict[n] for n in leaf_names]
+            with FB._swapped(template, leaf_names, arrs, [], []):
+                with _random.key_context(key):
+                    out = template(Tensor._from_array(h))
+            return out._array
+
+        if remat:
+            block_apply = jax.checkpoint(block_apply)
+
+        def run(x, *a, **kw):
+            h = decomp["pre"](x, *a, **kw)
+            harr = h._array
+            B = harr.shape[0]
+            if B % M != 0:
+                raise ValueError(
+                    f"batch {B} not divisible by {M} microbatches "
+                    "(strategy.hybrid_configs['accumulate_steps'])")
+            mb = B // M
+            x_mb = harr.reshape((M, mb) + harr.shape[1:])
+            if mesh_mod.degree("dp") > 1:
+                x_mb = jax.lax.with_sharding_constraint(
+                    x_mb, NamedSharding(mesh, P(None, "dp")))
+            y_mb = pipeline_apply_hybrid(
+                block_apply, stacked, x_mb, rng, mesh,
+                n_stages=self.pp, n_microbatches=M)
+            y = y_mb.reshape((B,) + y_mb.shape[2:])
+            return decomp["post"](Tensor._from_array(y))
+
+        return run
+
     def _build(self, batch_arrays):
         model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
         mesh = mesh_mod.get_mesh()
+        use_pp = self.use_pp
+        outer_names = None
+        bn = [n for n, _ in model.named_buffers()]
+        if use_pp:
+            outer_named, _, leaf_names, _ = self._pp_split()
+            outer_names = [n for n, _ in outer_named]
 
-        def compute_loss(param_arrays, buffer_arrays, rng, batch):
-            out, new_buffers = FB.call_functional(
-                model, param_arrays, buffer_arrays, batch,
-                rng_key=rng, fn=lambda *ts: loss_fn(model, *ts))
+        def compute_loss(param_tree, buffer_arrays, rng, batch):
+            if not use_pp:
+                out, new_buffers = FB.call_functional(
+                    model, param_tree, buffer_arrays, batch,
+                    rng_key=rng, fn=lambda *ts: loss_fn(model, *ts))
+                return out, new_buffers
+            outer_arrays, stacked = param_tree
+            with FB._swapped(model, outer_names, outer_arrays, bn,
+                             buffer_arrays) as (_, buffers):
+                with _random.key_context(rng), _engine.no_grad():
+                    shim = _PipelineShim(
+                        model, self._make_run_pipeline(stacked, rng))
+                    wrapped = [Tensor._from_array(a) for a in batch]
+                    out = loss_fn(shim, *wrapped)
+                new_buffers = [buffers[n]._array for n in bn]
+            out = out._array if isinstance(out, Tensor) else out
             return out, new_buffers
 
-        def step_fn(param_arrays, buffer_arrays, opt_state, lr, step, rng,
+        def flatten(param_tree):
+            if not use_pp:
+                return param_tree
+            outer_arrays, stacked = param_tree
+            return list(outer_arrays) + [stacked[ln] for ln in leaf_names]
+
+        def unflatten(flat, like_tree):
+            if not use_pp:
+                return flat
+            n_outer = len(like_tree[0])
+            outer = flat[:n_outer]
+            stacked = dict(zip(leaf_names, flat[n_outer:]))
+            return (outer, stacked)
+
+        from ..framework import debugging as _dbg
+        check = _dbg.enabled()
+
+        gmap = getattr(optimizer, "_group_by_id", {})
+        if use_pp:
+            fleet_names = self._fleet_param_names
+            fleet_scales = self._fleet_lr_scales
+            fleet_wds = self._fleet_wd_overrides
+        else:
+            named = list(model.named_parameters())
+            fleet_names = [n for n, _ in named]
+            fleet_scales = [gmap.get(id(p), (1.0, None))[0] for _, p in named]
+            fleet_wds = [gmap.get(id(p), (1.0, None))[1] for _, p in named]
+            self._fleet_param_names = fleet_names
+
+        def step_fn(param_tree, buffer_arrays, opt_state, lr, step, rng,
                     batch):
             (loss, new_buffers), grads = jax.value_and_grad(
                 compute_loss, has_aux=True)(
-                    param_arrays, buffer_arrays, rng, batch)
+                    param_tree, buffer_arrays, rng, batch)
+            flat_g = flatten(grads)
+            flat_p = flatten(param_tree)
+            finite = _dbg.finite_flags(loss, flat_g) if check else None
             if optimizer._grad_clip is not None:
-                grads = optimizer._clip_grad_arrays(grads)
-            new_params, new_opt = optimizer.update(
-                grads, param_arrays, opt_state, lr, step)
-            return loss, new_params, new_buffers, new_opt
+                flat_g = optimizer._clip_grad_arrays(flat_g)
+            new_flat, new_opt = optimizer.update(
+                flat_g, flat_p, opt_state, lr, step,
+                param_names=fleet_names, lr_scales=fleet_scales,
+                wd_overrides=fleet_wds)
+            new_params = unflatten(new_flat, param_tree)
+            return loss, new_params, new_buffers, new_opt, finite
 
         params, p_specs, p_sh, b_sh = self._shardings()
+        arrays, flat_specs = self._flat_param_arrays()
         state_sh = [
             {name: NamedSharding(mesh, state_pspec(spec, arr.shape,
                                                    self.sharding_stage))
              for name, arr in slots.items()}
-            for slots, spec in zip(self._opt_state, p_specs)]
+            for slots, spec in zip(self._opt_state, flat_specs)]
         repl = NamedSharding(mesh, P())
+        if use_pp:
+            _, blocks, leaf_names_, _ = self._pp_split()
+            st_specs = self._stacked_specs(blocks, leaf_names_)
+            st_sh = {ln: NamedSharding(mesh, st_specs[ln])
+                     for ln in leaf_names_}
+            param_in_sh = (p_sh, st_sh)
+        else:
+            param_in_sh = p_sh
         batch_sh = tuple(
             NamedSharding(mesh, P(*(["dp"] + [None] * (a.ndim - 1))))
             if a.ndim > 0 else repl for a in batch_arrays)
-        in_sh = (p_sh, b_sh, state_sh, repl, repl, repl, batch_sh)
-        out_sh = (repl, p_sh, b_sh, state_sh)
+        in_sh = (param_in_sh, b_sh, state_sh, repl, repl, repl, batch_sh)
+        out_sh = (repl, param_in_sh, b_sh, state_sh, repl if check else None)
         self._jitted = jax.jit(step_fn, in_shardings=in_sh,
                                out_shardings=out_sh,
                                donate_argnums=(0, 2))
@@ -164,21 +440,44 @@ class DistributedTrainStep:
         model, optimizer = self.model, self.optimizer
         if not self._placed:
             self._place_state()
-        pn, pa, bn, ba = FB.split_state(model)
         batch_arrays = tuple(
             b._array if isinstance(b, Tensor) else jnp.asarray(b)
             for b in batch)
         if self._jitted is None:
             self._build(batch_arrays)
+        if self.use_pp:
+            outer_named, _, leaf_names, _ = self._pp_split()
+            pn = [n for n, _ in outer_named]
+            pa = [p._array for _, p in outer_named]
+            param_tree = (pa, self._stacked)
+        else:
+            pn, pa, _, _ = FB.split_state(model)
+            param_tree = pa
+        batch_arrays = self._globalize_batch(batch_arrays)
+        bn = [n for n, _ in model.named_buffers()]
+        ba = [b._array for _, b in model.named_buffers()]
         self._step += 1
         lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
         step = jnp.asarray(self._step, jnp.float32)
         rng = _random.next_key()
-        loss, new_params, new_buffers, self._opt_state = self._jitted(
-            pa, ba, self._opt_state, lr, step, rng, batch_arrays)
+        loss, new_params, new_buffers, self._opt_state, finite = self._jitted(
+            param_tree, ba, self._opt_state, lr, step, rng, batch_arrays)
+        if finite is not None:
+            from ..framework import debugging as _dbg
+            _dbg.raise_on_nonfinite(
+                finite, getattr(self, "_fleet_param_names", None)
+                or self.optimizer._param_names, self._step)
         params = dict(model.named_parameters())
-        for n, a in zip(pn, new_params):
-            params[n]._inplace_assign(a)
+        if self.use_pp:
+            new_outer, self._stacked = new_params
+            for n, a in zip(pn, new_outer):
+                params[n]._inplace_assign(a)
+            self._model_stale = True
+            # state_dict() auto-syncs the stacked stage params back
+            model._pp_sync = self.sync_model
+        else:
+            for n, a in zip(pn, new_params):
+                params[n]._inplace_assign(a)
         buffers = dict(model.named_buffers())
         for n, a in zip(bn, new_buffers):
             buffers[n]._inplace_assign(a)

@@ -88,6 +88,86 @@ def pipeline_apply(stage_fn, stacked_params, x_microbatched, mesh,
     return out[0]
 
 
+def gpipe_hybrid(block_apply, n_stages, n_microbatches, axis_name="pp"):
+    """GPipe schedule as a *partial-manual* shard_map body: manual over the
+    "pp" mesh axis only, leaving "dp"/"mp" to GSPMD inside the body — so
+    tensor-parallel param annotations and dp batch sharding keep working
+    inside the pipelined region (reference analog: Fleet composing
+    PipelineParallel with NCCL tp/dp groups — here XLA composes them).
+
+    block_apply(leaf_dict, x, key) -> y  runs ONE block on one microbatch.
+    Returns pipelined(stacked_params, x_mb, key) for use under
+    ``jax.shard_map(..., axis_names={axis_name})`` where stacked leaves are
+    [n_stages, layers_per_stage, ...] (leading axis sharded over pp) and
+    x_mb is [M, mb, ...].
+
+    NOTE: partial-manual shard_map only lowers under jit in current jax —
+    the fleet engine always calls this inside its pjit'd step.
+    """
+
+    def stage_fn(stage_params, x, key):
+        n_layers = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+
+        def scan_block(h, xs):
+            layer_params, li = xs
+            k = jax.random.fold_in(key, li)
+            return block_apply(layer_params, h, k), None
+
+        y, _ = lax.scan(scan_block, x,
+                        (stage_params, jnp.arange(n_layers)))
+        return y
+
+    def pipelined(stacked_params, x_mb, key):
+        # under shard_map the pp axis is manual: leading dim == 1 here
+        my_params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+        idx = lax.axis_index(axis_name)
+        P_, M = n_stages, n_microbatches
+        T = M + P_ - 1
+        mb_shape = x_mb.shape[1:]
+        key = jax.random.fold_in(key, idx)
+
+        out_buf = jnp.zeros((M,) + mb_shape, x_mb.dtype)
+        state = jnp.zeros(mb_shape, x_mb.dtype)
+
+        def body(carry, t):
+            state, out_buf = carry
+            inject = x_mb[jnp.clip(t, 0, M - 1)]
+            cur = jnp.where(idx == 0, inject, state)
+            y = stage_fn(my_params, cur, jax.random.fold_in(key, t))
+            emit_t = jnp.clip(t - (P_ - 1), 0, M - 1)
+            is_emit = (t >= P_ - 1) & (idx == P_ - 1)
+            prev = lax.dynamic_index_in_dim(out_buf, emit_t, 0,
+                                            keepdims=False)
+            upd = jnp.where(is_emit, y, prev)
+            out_buf = lax.dynamic_update_index_in_dim(out_buf, upd, emit_t, 0)
+            perm = [(i, (i + 1) % P_) for i in range(P_)]
+            state = lax.ppermute(y, axis_name, perm)
+            return (state, out_buf), None
+
+        (state, out_buf), _ = lax.scan(body, (state, out_buf),
+                                       jnp.arange(T))
+        out = lax.psum(
+            jnp.where(idx == P_ - 1, out_buf,
+                      jnp.zeros_like(out_buf)), axis_name)
+        return out[None]
+
+    return pipelined
+
+
+def pipeline_apply_hybrid(block_apply, stacked_params, x_mb, key, mesh,
+                          n_stages, n_microbatches, axis_name="pp"):
+    """Run the hybrid GPipe schedule; must be called inside jit (the fleet
+    engine's pjit step).  x_mb: [M, mb, ...]; returns [M, mb, ...]."""
+    fn = gpipe_hybrid(block_apply, n_stages, n_microbatches, axis_name)
+    param_specs = jax.tree_util.tree_map(
+        lambda _: P(axis_name), stacked_params)
+    mapped = jax.shard_map(fn, mesh=mesh,
+                           in_specs=(param_specs, P(), P()),
+                           out_specs=P(axis_name),
+                           axis_names={axis_name}, check_vma=False)
+    return mapped(stacked_params, x_mb, key)[0]
+
+
 class PipelineLayer:
     """Stage-partition descriptor (reference: PipelineLayer in pp_layers.py).
 

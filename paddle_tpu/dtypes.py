@@ -40,16 +40,33 @@ _STR_ALIASES = {
 _DEFAULT_DTYPE = [float32]
 
 
+# TPU-native 64-bit policy: XLA:TPU has no fast int64/fp64 path and jax
+# runs with x64 disabled, where a requested 64-bit dtype silently
+# truncates AND warns on every call.  We make the truncation the explicit,
+# warning-free contract: 64-bit requests (paddle's default int dtype is
+# int64) resolve to their 32-bit counterparts unless jax x64 is enabled.
+_X64_DOWNGRADE = {
+    int64: int32,
+    jnp.dtype("uint64"): jnp.dtype("uint32"),
+    float64: float32,
+    complex128: complex64,
+}
+
+
 def convert_dtype(dtype):
-    """Normalize any user-supplied dtype (str / np / jnp / paddle-style) to jnp.dtype."""
+    """Normalize any user-supplied dtype (str / np / jnp / paddle-style) to
+    jnp.dtype, applying the 64→32-bit policy when jax x64 is off."""
     if dtype is None:
         return None
     if isinstance(dtype, str):
         key = dtype.lower().replace("paddle.", "")
-        if key in _STR_ALIASES:
-            return _STR_ALIASES[key]
-        return jnp.dtype(key)
-    return jnp.dtype(dtype)
+        d = _STR_ALIASES.get(key) or jnp.dtype(key)
+    else:
+        d = jnp.dtype(dtype)
+    import jax
+    if not jax.config.jax_enable_x64:
+        d = _X64_DOWNGRADE.get(d, d)
+    return d
 
 
 def set_default_dtype(dtype):

@@ -1,0 +1,54 @@
+"""Failure detection: finite-checks on losses/grads/tensors.
+
+Reference surface: paddle.amp.debugging.check_numerics +
+FLAGS_check_nan_inf (paddle/phi/kernels/check_numerics_kernel.*).  The
+TPU-native version computes all-finite flags INSIDE the jitted step (one
+fused reduction per tensor, negligible next to the matmuls) and raises on
+the host with the offending parameter names — enable with
+``PT_CHECK_NUMERICS=1`` or ``set_flags({"check_numerics": True})``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import flags
+
+
+def enabled() -> bool:
+    return bool(flags.get_flags("check_numerics"))
+
+
+def finite_flags(loss, grads):
+    """[1 + len(grads)] bool vector: loss all-finite, then each grad."""
+    out = [jnp.isfinite(loss).all()]
+    for g in grads:
+        out.append(jnp.isfinite(g).all() if g is not None
+                   else jnp.asarray(True))
+    return jnp.stack(out)
+
+
+def raise_on_nonfinite(flags_arr, names, step):
+    """Host-side check of the traced flags; raises with offender names."""
+    import numpy as np
+    ok = np.asarray(flags_arr)
+    if ok.all():
+        return
+    labels = ["loss"] + list(names)
+    bad = [labels[i] for i in np.nonzero(~ok)[0]]
+    raise FloatingPointError(
+        f"check_numerics: non-finite values at step {step} in: "
+        + ", ".join(bad[:8])
+        + (f" (+{len(bad) - 8} more)" if len(bad) > 8 else ""))
+
+
+def check_numerics(tensor, name="tensor"):
+    """Eager check (paddle.amp.debugging.check_numerics surface): raises if
+    the tensor contains nan/inf.  No-op when the flag is off."""
+    if not enabled():
+        return tensor
+    import numpy as np
+    arr = tensor._array if hasattr(tensor, "_array") else tensor
+    if not np.asarray(jnp.isfinite(arr).all()):
+        raise FloatingPointError(
+            f"check_numerics: non-finite values in {name}")
+    return tensor

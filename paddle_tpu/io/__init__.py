@@ -251,6 +251,37 @@ def _numpy_collate(batch):
     return batch
 
 
+def _stage_to_device(batch):
+    """Start async H2D transfers for every array in the batch (device_put
+    is non-blocking; jax arrays already on device are a no-op)."""
+    import jax
+
+    def put(x):
+        if isinstance(x, Tensor):
+            return Tensor._from_array(jax.device_put(x._array))
+        if isinstance(x, (np.ndarray, np.generic)):
+            return Tensor._from_array(jax.device_put(x))
+        if isinstance(x, (tuple, list)):
+            return type(x)(put(v) for v in x)
+        if isinstance(x, dict):
+            return {k: put(v) for k, v in x.items()}
+        return x
+
+    return put(batch)
+
+
+def _device_buffered(iterator, depth=2):
+    """Yield batches with `depth`-deep device staging lookahead."""
+    import collections
+    buf = collections.deque()
+    for batch in iterator:
+        buf.append(_stage_to_device(batch))
+        if len(buf) >= depth:
+            yield buf.popleft()
+    while buf:
+        yield buf.popleft()
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -261,6 +292,7 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.use_buffer_reader = use_buffer_reader
         self.prefetch_factor = max(prefetch_factor, 1)
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
@@ -294,6 +326,16 @@ class DataLoader:
                 yield [self.dataset[i] for i in idxs]
 
     def __iter__(self):
+        it = self._batches_iter()
+        if self.use_buffer_reader:
+            # async H2D double-buffer (reference: DataLoader's buffer
+            # reader — pinned-memory async copies): jax.device_put returns
+            # immediately, so staging batch N+1 while the caller consumes
+            # batch N overlaps the host→device transfer with compute.
+            it = _device_buffered(it, depth=self.prefetch_factor)
+        yield from it
+
+    def _batches_iter(self):
         if self.num_workers == 0:
             for samples in self._index_batches():
                 yield self.collate_fn(samples)

@@ -34,6 +34,8 @@ class TrainStep:
 
     def _build(self):
         model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
+        from ..framework import debugging as _dbg
+        check = self._check_numerics = _dbg.enabled()
 
         def compute_loss(param_arrays, buffer_arrays, rng, batch_arrays):
             out, new_buffers = FB.call_functional(
@@ -42,22 +44,36 @@ class TrainStep:
             loss = out
             return loss, new_buffers
 
+        # engine-order bookkeeping: params flow through in named_parameters
+        # order, which may differ from the optimizer's param-group order —
+        # align names/group lr scales by identity
+        named = list(model.named_parameters())
+        gmap = getattr(optimizer, "_group_by_id", {})
+        p_names = [n for n, _ in named]
+        p_scales = [gmap.get(id(p), (1.0, None))[0] for _, p in named]
+        p_wds = [gmap.get(id(p), (1.0, None))[1] for _, p in named]
+
         def step_fn(param_arrays, buffer_arrays, opt_state, lr, step, rng,
                     batch_arrays):
             (loss, new_buffers), grads = jax.value_and_grad(
                 compute_loss, has_aux=True)(
                     param_arrays, buffer_arrays, rng, batch_arrays)
+            finite = _dbg.finite_flags(loss, grads) if check else None
             if optimizer._grad_clip is not None:
                 grads = optimizer._clip_grad_arrays(grads)
             new_params, new_opt_state = optimizer.update(
-                grads, param_arrays, opt_state, lr, step)
-            return loss, new_params, new_buffers, new_opt_state
+                grads, param_arrays, opt_state, lr, step,
+                param_names=p_names, lr_scales=p_scales, wd_overrides=p_wds)
+            return loss, new_params, new_buffers, new_opt_state, finite
 
         donate = (0, 2) if self._donate else ()
         self._jitted = jax.jit(step_fn, donate_argnums=donate)
 
     def __call__(self, *batch):
         model, optimizer = self.model, self.optimizer
+        sync = getattr(model, "_pp_sync", None)
+        if sync is not None:  # flush a prior pp engine's stacked weights
+            sync()            # before training eagerly from the model
         pn, pa, bn, ba = FB.split_state(model)
         if self._opt_state is None:
             # adopt any state the optimizer already has; else init
@@ -72,8 +88,11 @@ class TrainStep:
         batch_arrays = tuple(
             b._array if isinstance(b, Tensor) else jnp.asarray(b)
             for b in batch)
-        loss, new_params, new_buffers, self._opt_state = self._jitted(
+        loss, new_params, new_buffers, self._opt_state, finite = self._jitted(
             pa, ba, self._opt_state, lr, step, rng, batch_arrays)
+        if finite is not None:
+            from ..framework import debugging as _dbg
+            _dbg.raise_on_nonfinite(finite, pn, self._step)
         params = dict(model.named_parameters())
         for n, a in zip(pn, new_params):
             params[n]._inplace_assign(a)

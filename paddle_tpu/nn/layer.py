@@ -135,7 +135,13 @@ class Layer:
                 if p is None or id(p) in seen:
                     continue
                 seen.add(id(p))
-                yield (f"{name}.{pname}" if name else pname), p
+                full = f"{name}.{pname}" if name else pname
+                if p.name is None:
+                    # baptize with the structured name so name-based
+                    # predicates (apply_decay_param_fun) see the same
+                    # string in eager optimizer.step() and fused paths
+                    p.name = full
+                yield full, p
             if not include_sublayers:
                 break
 
@@ -161,6 +167,9 @@ class Layer:
     # ----------------------------------------------------------- state dict
     def state_dict(self, destination=None, include_sublayers=True,
                    structured_name_prefix=""):
+        sync = getattr(self, "_pp_sync", None)
+        if sync is not None:  # pp training keeps block params stacked in the
+            sync()            # fleet step; scatter back before reading state
         out = OrderedDict() if destination is None else destination
         for name, p in self.named_parameters(prefix=structured_name_prefix):
             out[name] = p

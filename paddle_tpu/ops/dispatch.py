@@ -48,11 +48,13 @@ def get(name) -> OpDef:
     return _REGISTRY[name]
 
 
-def _amp_cast(tensors, policy):
+def _amp_cast(tensors, policy, op_name=None):
     from .. import amp
     state = amp.amp_state()
     if state is None:
         return tensors
+    if op_name is not None:
+        policy = state.policy_for(op_name, policy)
     target = state.dtype
     if state.level == "O2":
         cast_to = jnp.float32 if policy == "deny" else target
@@ -81,7 +83,7 @@ def call(name, *tensor_args, **consts):
     """Dispatch: amp-cast → autograd-recorded execution of the kernel."""
     op = _REGISTRY[name]
     if name != "cast":
-        tensor_args = _amp_cast(list(tensor_args), op.amp)
+        tensor_args = _amp_cast(list(tensor_args), op.amp, op_name=name)
     return engine.apply(name, op.fn, tensor_args, consts)
 
 

@@ -27,7 +27,28 @@ class Optimizer:
         if parameters is None:
             raise ValueError(
                 "parameters must be provided (dygraph-style optimizer)")
-        self._parameters = list(parameters)
+        parameters = list(parameters)
+        # reference param-group semantics (python/paddle/optimizer/
+        # optimizer.py _update_param_group): list-of-dict with a "params"
+        # key; a group "learning_rate" is a COEFFICIENT on the global lr,
+        # "weight_decay" overrides the global decay for that group.
+        if parameters and isinstance(parameters[0], dict):
+            self._parameters, self._lr_scales, self._wd_overrides = [], [], []
+            for group in parameters:
+                ps = list(group["params"])
+                scale = float(group.get("learning_rate", 1.0))
+                wd = group.get("weight_decay", None)
+                wd = None if wd is None else _decay_value(wd)
+                self._parameters.extend(ps)
+                self._lr_scales.extend([scale] * len(ps))
+                self._wd_overrides.extend([wd] * len(ps))
+        else:
+            self._parameters = parameters
+            self._lr_scales = [1.0] * len(parameters)
+            self._wd_overrides = [None] * len(parameters)
+        self._group_by_id = {
+            id(p): (s, w) for p, s, w in zip(
+                self._parameters, self._lr_scales, self._wd_overrides)}
         self._param_names = [
             p.name or f"param_{i}" for i, p in enumerate(self._parameters)]
         self._lr = learning_rate
@@ -76,21 +97,39 @@ class Optimizer:
         return {n for n in self._param_names
                 if self._apply_decay_param_fun(n)}
 
-    def update(self, grads, params, state, lr, step):
-        """Flat-list functional update; jit/pjit-safe."""
-        decay_mask = [n in self._decayed_names() for n in self._param_names]
+    def update(self, grads, params, state, lr, step,
+               param_names=None, lr_scales=None, wd_overrides=None):
+        """Flat-list functional update; jit/pjit-safe.
+
+        The optional overrides let a caller with a different flat layout
+        (the fleet pp engine stacks block params into per-leaf arrays) keep
+        decay masks / group lr scales aligned without mutating this
+        optimizer's own parameter bookkeeping."""
+        names = param_names if param_names is not None else self._param_names
+        if self._apply_decay_param_fun is None:
+            decay_mask = [True] * len(params)
+        else:
+            decay_mask = [self._apply_decay_param_fun(n) for n in names]
+        n = len(params)
+        scales = lr_scales if lr_scales is not None else \
+            (getattr(self, "_lr_scales", None) or [1.0] * n)
+        wds = wd_overrides if wd_overrides is not None else \
+            (getattr(self, "_wd_overrides", None) or [None] * n)
         new_params, new_state = [], []
-        for g, p, slots, dec in zip(grads, params, state, decay_mask):
+        for g, p, slots, dec, scale, wd in zip(
+                grads, params, state, decay_mask, scales, wds):
             if g is None:
                 new_params.append(p)
                 new_state.append(slots)
                 continue
+            lr_i = lr * scale if scale != 1.0 else lr
+            wd_i = self._weight_decay if wd is None else wd
             compute_p = slots.get("master", p)
             gf = g.astype(jnp.float32)
             pf = compute_p.astype(jnp.float32)
-            gf = self._pre_grad(gf, pf, dec)
-            np_, ns = self._rule(gf, pf, dict(slots), lr, step)
-            np_ = self._post_param(np_, pf, dec, lr)
+            gf = self._pre_grad(gf, pf, dec, wd_i)
+            np_, ns = self._rule(gf, pf, dict(slots), lr_i, step)
+            np_ = self._post_param(np_, pf, dec, lr_i, wd_i)
             if "master" in slots:
                 ns["master"] = np_
                 new_params.append(np_.astype(p.dtype))
@@ -100,16 +139,18 @@ class Optimizer:
             new_state.append(ns)
         return new_params, new_state
 
-    def _pre_grad(self, g, p, decayed):
+    def _pre_grad(self, g, p, decayed, wd=None):
         # coupled L2 (reference regularizer semantics: SGD/Momentum/Adam)
-        if self._weight_decay and self._couple_decay and decayed:
-            return g + self._weight_decay * p
+        wd = self._weight_decay if wd is None else wd
+        if wd and self._couple_decay and decayed:
+            return g + wd * p
         return g
 
-    def _post_param(self, new_p, old_p, decayed, lr):
+    def _post_param(self, new_p, old_p, decayed, lr, wd=None):
         # decoupled decay (AdamW)
-        if self._weight_decay and not self._couple_decay and decayed:
-            return new_p - lr * self._weight_decay * old_p
+        wd = self._weight_decay if wd is None else wd
+        if wd and not self._couple_decay and decayed:
+            return new_p - lr * wd * old_p
         return new_p
 
     _couple_decay = True

@@ -274,16 +274,35 @@ class Tensor:
             yield self[i]
 
     def __bool__(self):
-        return bool(self._array)
+        return self._concretize(bool, "bool()")
 
     def __int__(self):
-        return int(self._array)
+        return self._concretize(int, "int()")
 
     def __float__(self):
-        return float(self._array)
+        return self._concretize(float, "float()")
 
     def __index__(self):
-        return int(self._array)
+        return self._concretize(int, "__index__")
+
+    def _concretize(self, conv, what):
+        """Host conversion; under to_static/jit tracing this is
+        data-dependent Python control flow, which a traced program cannot
+        express — raise the framework's error instead of a raw jax one
+        (reference: dy2static transcribes `if tensor:` into cond ops; our
+        trace-based design must reject it loudly, SURVEY §3.2)."""
+        import jax.errors
+        try:
+            return conv(self._array)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError) as e:
+            raise RuntimeError(
+                f"{what} of a traced Tensor inside paddle_tpu.jit.to_static/"
+                "jit: data-dependent Python control flow (if/while on tensor "
+                "values, python int()/float() casts) cannot be captured by "
+                "tracing. Use paddle_tpu.where / lax.cond-style ops, move "
+                "the branch outside the compiled function, or mark the "
+                "value as a static argument.") from e
 
     def __repr__(self):
         grad_s = "" if self.stop_gradient else ", stop_gradient=False"

@@ -79,7 +79,7 @@ def arange(start=0, end=None, step=1, dtype=None):
     if d is None:
         if builtins.all(isinstance(v, builtins.int)
                         for v in (start, end, step)):
-            d = dtypes.int64
+            d = dtypes.convert_dtype(dtypes.int64)
         else:
             d = dtypes.get_default_dtype()
     return Tensor._from_array(jnp.arange(start, end, step, dtype=d))
@@ -162,13 +162,13 @@ def normal(mean=0.0, std=1.0, shape=None):
 def randint(low=0, high=None, shape=(1,), dtype=None):
     if high is None:
         low, high = 0, low
-    d = dtypes.convert_dtype(dtype) or dtypes.int64
+    d = dtypes.convert_dtype(dtype if dtype is not None else dtypes.int64)
     return Tensor._from_array(jax.random.randint(
         _random.next_key(), tuple(shape), low, high, dtype=d))
 
 
 def randperm(n, dtype=None):
-    d = dtypes.convert_dtype(dtype) or dtypes.int64
+    d = dtypes.convert_dtype(dtype if dtype is not None else dtypes.int64)
     return Tensor._from_array(
         jax.random.permutation(_random.next_key(), n).astype(d))
 
@@ -183,7 +183,7 @@ def multinomial(x, num_samples=1, replacement=False):
         k = _random.next_key()
         g = jax.random.gumbel(k, logits.shape)
         out = jnp.argsort(-(logits + g), axis=-1)[..., :num_samples]
-    return Tensor._from_array(out.astype(jnp.int64))
+    return Tensor._from_array(out.astype(dtypes.convert_dtype(dtypes.int64)))
 
 
 def bernoulli(x):

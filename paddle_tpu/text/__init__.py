@@ -12,4 +12,4 @@ from .llama import (  # noqa: F401
 from .ernie import (  # noqa: F401
     ErnieConfig, ErnieModel, ErnieForSequenceClassification,
 )
-from .generation import generate  # noqa: F401
+from .generation import generate, beam_search  # noqa: F401

@@ -69,3 +69,89 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
     finally:
         if was_training:
             model.train()
+
+
+def beam_search(model, input_ids, beam_size=4, max_new_tokens=20,
+                length_penalty=1.0, eos_token_id=None):
+    """Beam-search decode (reference analog: PaddleNLP
+    generation_utils.beam_search).  Beams ride the batch axis ([b*beam]),
+    so every model step stays a single batched XLA call; KV caches are
+    gathered along the batch dim on each beam reorder.
+
+    Returns Tensor [b, prompt + new] — the highest-scoring finished beam
+    per batch row under the GNMT length penalty ((5+len)/6)**alpha.
+    """
+    was_training = model.training
+    model.eval()
+    try:
+        from ..autograd import engine
+        with engine.no_grad():
+            return _beam_search_impl(model, input_ids, beam_size,
+                                     max_new_tokens, length_penalty,
+                                     eos_token_id)
+    finally:
+        if was_training:
+            model.train()
+
+
+def _beam_penalty(length, alpha):
+    return ((5.0 + length) / 6.0) ** alpha
+
+
+def _beam_search_impl(model, input_ids, beam, max_new, alpha, eos_id):
+    b, prompt = input_ids.shape
+    dtype = next(iter(model.parameters()))._array.dtype
+    ids = jnp.repeat(input_ids._array, beam, axis=0)        # [b*beam, prompt]
+    caches = model.new_caches(b * beam, dtype=dtype)
+    logits = model(Tensor._from_array(ids), caches=caches)
+    logp = jax.nn.log_softmax(
+        logits._array[:, -1, :].astype(jnp.float32), axis=-1)
+    V = logp.shape[-1]
+    # step 0: all beams identical — keep only beam 0 alive to avoid dupes
+    init = jnp.tile(jnp.asarray([0.0] + [-1e9] * (beam - 1)), b)[:, None]
+    scores = (logp + init).reshape(b, beam * V)
+    beam_scores, top = jax.lax.top_k(scores, beam)          # [b, beam]
+    src_beam, tok = top // V, (top % V).astype(ids.dtype)
+    gather = (jnp.arange(b)[:, None] * beam + src_beam).reshape(-1)
+    seqs = jnp.concatenate([ids[gather], tok.reshape(-1, 1)], axis=1)
+    _reorder_caches(caches, gather)
+    beam_scores = beam_scores.reshape(-1)                    # [b*beam]
+    finished = jnp.zeros((b * beam,), bool)
+    if eos_id is not None:
+        finished = seqs[:, -1] == eos_id
+    gen_lens = jnp.ones((b * beam,), jnp.float32)  # per-beam finished length
+
+    for _ in range(max_new - 1):
+        if eos_id is not None and bool(finished.all()):
+            break
+        logits = model(Tensor._from_array(seqs[:, -1:]), caches=caches)
+        logp = jax.nn.log_softmax(
+            logits._array[:, -1, :].astype(jnp.float32), axis=-1)
+        if eos_id is not None:
+            # finished beams may only extend with eos at unchanged score
+            frozen = jnp.full((V,), -jnp.inf).at[eos_id].set(0.0)
+            logp = jnp.where(finished[:, None], frozen[None, :], logp)
+        scores = (beam_scores[:, None] + logp).reshape(b, beam * V)
+        beam_scores, top = jax.lax.top_k(scores, beam)
+        src_beam, tok = top // V, (top % V).astype(ids.dtype)
+        gather = (jnp.arange(b)[:, None] * beam + src_beam).reshape(-1)
+        seqs = jnp.concatenate(
+            [seqs[gather], tok.reshape(-1, 1)], axis=1)
+        _reorder_caches(caches, gather)
+        beam_scores = beam_scores.reshape(-1)
+        # a beam's length only grows while it was still alive
+        gen_lens = gen_lens[gather] + (~finished[gather]).astype(jnp.float32)
+        if eos_id is not None:
+            finished = finished[gather] | (seqs[:, -1] == eos_id)
+
+    # pick best beam per batch under the per-beam GNMT length penalty
+    final = beam_scores / _beam_penalty(gen_lens, alpha)
+    best = jnp.argmax(final.reshape(b, beam), axis=1)
+    pick = jnp.arange(b) * beam + best
+    return Tensor._from_array(seqs[pick])
+
+
+def _reorder_caches(caches, gather):
+    for c in caches:
+        c["k"] = Tensor._from_array(c["k"]._array[gather])
+        c["v"] = Tensor._from_array(c["v"]._array[gather])

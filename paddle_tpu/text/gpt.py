@@ -203,6 +203,31 @@ class GPTForCausalLM(nn.Layer):
         logits = x.matmul(self.gpt.wte.weight, transpose_y=True)
         return logits
 
+    # ------------------------------------------------- pipeline parallelism
+    def pipeline_decompose(self):
+        """Stage plan for the fleet engine's pp path (reference analog:
+        PipelineLayer's LayerDesc segmentation in pp_layers.py).  The
+        homogeneous transformer blocks are pipelined; embedding and the
+        ln_f+tied-head stay outside under plain GSPMD (first/last-stage
+        layers in the reference)."""
+        return {
+            "blocks": list(self.gpt.h),
+            "pre": self._pp_pre,
+            "post": self._pp_post,
+            "remat": self.cfg.use_recompute,
+        }
+
+    def _pp_pre(self, input_ids):
+        from .. import tensor_api as T
+        b, s = input_ids.shape
+        position_ids = T.arange(0, s, dtype="int32").unsqueeze(0)
+        x = self.gpt.wte(input_ids) + self.gpt.wpe(position_ids)
+        return self.gpt.drop(x)
+
+    def _pp_post(self, x):
+        x = self.gpt.ln_f(x)
+        return x.matmul(self.gpt.wte.weight, transpose_y=True)
+
     def new_caches(self, batch_size, dtype="float32", max_length=None):
         """Concat-style caches (eager decode) or, with `max_length`, the
         preallocated static-shape caches the jitted decode loop uses."""

@@ -1,0 +1,106 @@
+"""paddle_tpu.utils (reference surface: python/paddle/utils — unique_name,
+try_import, run_check — plus the tensor helpers SURVEY §2 lists: clip,
+CosineSimilarity, einops-style rearrange helpers riding the baked-in einops
+package)."""
+from __future__ import annotations
+
+import itertools
+
+from ..tensor import Tensor
+from ..nn.utils_mod import parameters_to_vector, vector_to_parameters  # noqa: F401
+
+
+# ----------------------------------------------------------------- clipping
+def clip(x, min=None, max=None):
+    """Alias of paddle.clip living under utils per SURVEY §2."""
+    from .. import tensor_api as T
+    return T.clip(x, min, max)
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0):
+    """In-place global-norm gradient clip over eager .grad fields
+    (torch-style helper the reference exposes via nn.utils)."""
+    import jax.numpy as jnp
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor._from_array(jnp.asarray(0.0))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(p.grad._array)) for p in params]))
+    else:
+        total = jnp.power(sum(
+            jnp.sum(jnp.abs(p.grad._array) ** norm_type) for p in params),
+            1.0 / norm_type)
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        p.grad._inplace_assign(p.grad._array * scale)
+    return Tensor._from_array(total)
+
+
+# ------------------------------------------------------------- similarity
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    from ..nn import functional as F
+    return F.cosine_similarity(x1, x2, axis=axis, eps=eps)
+
+
+class CosineSimilarity:
+    def __init__(self, axis=1, eps=1e-8):
+        self.axis, self.eps = axis, eps
+
+    def __call__(self, x1, x2):
+        return cosine_similarity(x1, x2, self.axis, self.eps)
+
+
+# ------------------------------------------------------- einops helpers
+def rearrange(x, pattern, **axes_lengths):
+    import einops
+    arr = x._array if isinstance(x, Tensor) else x
+    return Tensor._from_array(einops.rearrange(arr, pattern, **axes_lengths))
+
+
+def repeat(x, pattern, **axes_lengths):
+    import einops
+    arr = x._array if isinstance(x, Tensor) else x
+    return Tensor._from_array(einops.repeat(arr, pattern, **axes_lengths))
+
+
+def reduce(x, pattern, reduction="mean", **axes_lengths):
+    import einops
+    arr = x._array if isinstance(x, Tensor) else x
+    return Tensor._from_array(
+        einops.reduce(arr, pattern, reduction, **axes_lengths))
+
+
+# -------------------------------------------------------------- misc surface
+class _UniqueNames:
+    def __init__(self):
+        self._counters = {}
+
+    def generate(self, prefix="name"):
+        c = self._counters.setdefault(prefix, itertools.count())
+        return f"{prefix}_{next(c)}"
+
+
+unique_name = _UniqueNames()
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed") from e
+
+
+def run_check():
+    """paddle.utils.run_check analog: verify the backend compiles + runs."""
+    import jax
+    import jax.numpy as jnp
+    x = jnp.ones((128, 128))
+    y = jax.jit(lambda a: a @ a)(x)
+    y.block_until_ready()
+    n = len(jax.devices())
+    print(f"paddle_tpu is installed successfully! "
+          f"backend={jax.default_backend()} devices={n}")
+    return True

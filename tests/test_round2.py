@@ -1,0 +1,281 @@
+"""Round-2 feature tests: amp custom lists, optimizer param groups,
+check_numerics failure detection."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+# --------------------------------------------------------------------- amp
+def test_amp_custom_white_list_casts_kept_op():
+    """An op with default policy "keep" casts to bf16 when white-listed."""
+    x = pt.ones([4, 4], dtype="float32")
+    with pt.amp.auto_cast(level="O1", dtype="bfloat16",
+                          custom_white_list=["add"]):
+        y = x + x
+    assert str(y.dtype) in ("paddle.bfloat16", "bfloat16") or \
+        "bfloat16" in str(y.dtype)
+
+
+def test_amp_custom_black_list_keeps_fp32():
+    """matmul (default "allow") stays fp32 when black-listed."""
+    a = pt.ones([4, 4], dtype="float32")
+    b = pt.ones([4, 4], dtype="float32")
+    with pt.amp.auto_cast(level="O1", dtype="bfloat16",
+                          custom_black_list=["matmul"]):
+        y = a.matmul(b)
+    assert "float32" in str(y.dtype)
+    with pt.amp.auto_cast(level="O1", dtype="bfloat16"):
+        y2 = a.matmul(b)
+    assert "bfloat16" in str(y2.dtype)
+
+
+def test_amp_black_wins_over_white():
+    a = pt.ones([4, 4], dtype="float32")
+    with pt.amp.auto_cast(level="O1", dtype="bfloat16",
+                          custom_white_list=["matmul"],
+                          custom_black_list=["matmul"]):
+        y = a.matmul(a)
+    assert "float32" in str(y.dtype)
+
+
+# ------------------------------------------------------------ param groups
+def test_optimizer_param_groups_lr_scale():
+    """Group learning_rate is a coefficient on the global lr."""
+    pt.seed(0)
+    a = pt.create_parameter([4], "float32")
+    b = pt.create_parameter([4], "float32")
+    a.set_value(pt.ones([4])); b.set_value(pt.ones([4]))
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=[
+        {"params": [a]},
+        {"params": [b], "learning_rate": 0.1},  # 10x smaller effective lr
+    ])
+    ga = pt.ones([4]); gb = pt.ones([4])
+    a.grad = ga; b.grad = gb
+    opt.step()
+    np.testing.assert_allclose(a.numpy(), 0.9 * np.ones(4), rtol=1e-6)
+    np.testing.assert_allclose(b.numpy(), 0.99 * np.ones(4), rtol=1e-6)
+
+
+def test_optimizer_param_groups_weight_decay_override():
+    """Group weight_decay overrides the global coefficient (AdamW)."""
+    pt.seed(0)
+    a = pt.create_parameter([4], "float32")
+    b = pt.create_parameter([4], "float32")
+    opt = pt.optimizer.AdamW(learning_rate=0.1, weight_decay=0.5,
+                             parameters=[
+                                 {"params": [a]},
+                                 {"params": [b], "weight_decay": 0.0},
+                             ])
+    a.set_value(pt.ones([4])); b.set_value(pt.ones([4]))
+    a.grad = pt.zeros([4]); b.grad = pt.zeros([4])
+    opt.step()
+    assert float(a.numpy()[0]) < 1.0          # decayed
+    np.testing.assert_allclose(b.numpy(), np.ones(4), atol=1e-7)  # not
+
+
+def test_param_groups_in_fused_train_step():
+    """Param groups survive the fused TrainStep path."""
+    pt.seed(2)
+    m = nn.Linear(4, 4)
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=[
+        {"params": [m.weight]},
+        {"params": [m.bias], "learning_rate": 0.0},  # frozen bias
+    ])
+    bias_before = m.bias.numpy().copy()
+    step = pt.jit.train_step(m, lambda mm, x, y: F.mse_loss(mm(x), y), opt)
+    x = pt.randn([8, 4]); y = pt.randn([8, 4])
+    for _ in range(2):
+        step(x, y)
+    np.testing.assert_allclose(m.bias.numpy(), bias_before, atol=1e-7)
+    assert not np.allclose(m.weight.numpy(),
+                           m.weight.numpy() * 0 + m.weight.numpy()[0, 0])
+
+
+# ---------------------------------------------------- trace-safety guards
+def test_to_static_data_dependent_branch_raises():
+    class Branchy(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            y = self.fc(x)
+            if y.sum() > 0:  # data-dependent python branch
+                return y
+            return -y
+
+    m = pt.jit.to_static(Branchy())
+    with pytest.raises(RuntimeError, match="to_static"):
+        m(pt.randn([2, 4]))
+
+
+def test_int64_requests_resolve_to_int32_without_warning():
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        a = pt.arange(0, 5, dtype="int64")
+        r = pt.randint(0, 5, [3])
+    assert "int32" in str(a.dtype) and "int32" in str(r.dtype)
+
+
+# ---------------------------------------------------------- check_numerics
+def test_check_numerics_raises_on_nan_loss():
+    from paddle_tpu.framework import flags
+    pt.seed(3)
+    m = nn.Linear(4, 4)
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+
+    def bad_loss(mm, x):
+        out = mm(x)
+        return (out.sum() - out.sum()) / (out.sum() - out.sum())  # nan
+
+    flags.set_flags({"check_numerics": True})
+    try:
+        step = pt.jit.train_step(m, bad_loss, opt)
+        with pytest.raises(FloatingPointError, match="check_numerics"):
+            step(pt.randn([2, 4]))
+    finally:
+        flags.set_flags({"check_numerics": False})
+
+
+def test_check_numerics_clean_run_passes():
+    from paddle_tpu.framework import flags
+    pt.seed(4)
+    m = nn.Linear(4, 4)
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    flags.set_flags({"check_numerics": True})
+    try:
+        step = pt.jit.train_step(
+            m, lambda mm, x, y: F.mse_loss(mm(x), y), opt)
+        loss = step(pt.randn([2, 4]), pt.randn([2, 4]))
+        assert np.isfinite(float(loss))
+    finally:
+        flags.set_flags({"check_numerics": False})
+
+
+def test_check_numerics_eager_api():
+    from paddle_tpu.framework import flags, debugging
+    flags.set_flags({"check_numerics": True})
+    try:
+        debugging.check_numerics(pt.ones([3]), "ok")  # no raise
+        bad = pt.ones([3]) / pt.zeros([3])
+        with pytest.raises(FloatingPointError):
+            debugging.check_numerics(bad, "bad")
+    finally:
+        flags.set_flags({"check_numerics": False})
+
+
+# ----------------------------------------------------------- paddle_tpu.utils
+def test_utils_surface():
+    from paddle_tpu import utils
+    x = pt.randn([4, 8]); y = pt.randn([4, 8])
+    c = utils.cosine_similarity(x, y, axis=1)
+    assert c.shape == [4] or tuple(c.shape) == (4,)
+    cs = utils.CosineSimilarity(axis=1)(x, y)
+    np.testing.assert_allclose(c.numpy(), cs.numpy())
+    r = utils.rearrange(x, "b (h w) -> b h w", h=2)
+    assert tuple(r.shape) == (4, 2, 4)
+    assert utils.unique_name.generate("fc") == "fc_0"
+    assert utils.unique_name.generate("fc") == "fc_1"
+    clipped = utils.clip(pt.ones([3]) * 5.0, max=1.0)
+    np.testing.assert_allclose(clipped.numpy(), np.ones(3))
+
+
+def test_utils_clip_grad_norm():
+    from paddle_tpu import utils
+    p = pt.create_parameter([4], "float32")
+    p.grad = pt.ones([4]) * 10.0
+    total = utils.clip_grad_norm_([p], max_norm=1.0)
+    assert float(total) > 1.0
+    np.testing.assert_allclose(
+        np.linalg.norm(p.grad.numpy()), 1.0, rtol=1e-4)
+
+
+# -------------------------------------------------------------- beam search
+def test_beam_search_gpt():
+    from paddle_tpu.text import GPTConfig, GPTForCausalLM, beam_search
+    pt.seed(21)
+    cfg = GPTConfig(vocab_size=32, hidden_size=16, num_layers=2, num_heads=2,
+                    max_position_embeddings=64, hidden_dropout=0.0,
+                    attention_dropout=0.0, tensor_parallel=False)
+    m = GPTForCausalLM(cfg)
+    ids = pt.randint(0, 32, [2, 4])
+    out = beam_search(m, ids, beam_size=3, max_new_tokens=5)
+    assert tuple(out.shape) == (2, 9)
+    # beam=1 must agree with greedy decode
+    b1 = beam_search(m, ids, beam_size=1, max_new_tokens=5)
+    greedy = m.generate(ids, max_new_tokens=5, use_jit=False)
+    np.testing.assert_array_equal(b1.numpy(), greedy.numpy())
+
+
+# ------------------------------------------------------- ernie inference demo
+def test_ernie_fused_inference_roundtrip(tmp_path):
+    """BASELINE config 5: ERNIE-3.0 inference via to_static → save_inference
+    → load_inference (the dy2static + CINN fused-graph analog)."""
+    from paddle_tpu.text import ErnieConfig, ErnieForSequenceClassification
+    from paddle_tpu.jit.save_load import (save_inference, load_inference,
+                                          InputSpec)
+    pt.seed(22)
+    cfg = ErnieConfig(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                      num_attention_heads=4, intermediate_size=64,
+                      max_position_embeddings=32)
+    m = ErnieForSequenceClassification(cfg, num_classes=3)
+    m.eval()
+    ids = pt.randint(0, 64, [2, 8])
+    eager = m(ids)
+    static = pt.jit.to_static(m)
+    fused = static(ids)
+    np.testing.assert_allclose(eager.numpy(), fused.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    path = str(tmp_path / "ernie_infer")
+    save_inference(m, path, [InputSpec([2, 8], "int32")])
+    loaded = load_inference(path)
+    out = loaded(ids)
+    got = out[0] if isinstance(out, (list, tuple)) else out
+    np.testing.assert_allclose(eager.numpy(), got.numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+# --------------------------------------------------- async device buffering
+def test_dataloader_buffer_reader_values_and_lookahead(monkeypatch):
+    """use_buffer_reader stages batches ahead of consumption (async H2D
+    overlap) without changing values or order."""
+    import paddle_tpu.io as io
+
+    xs = np.arange(32, dtype=np.float32).reshape(8, 4)
+    ys = np.arange(8, dtype=np.float32)
+
+    class DS(io.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return xs[i], ys[i]
+
+    staged = []
+    orig = io._stage_to_device
+
+    def tracking_stage(b):
+        staged.append(1)
+        return orig(b)
+
+    monkeypatch.setattr(io, "_stage_to_device", tracking_stage)
+    dl = io.DataLoader(DS(), batch_size=2, shuffle=False,
+                       use_buffer_reader=True, prefetch_factor=2)
+    it = iter(dl)
+    first = next(it)
+    # double-buffer: by the time batch 0 is handed out, batch 1 (at least)
+    # has already been staged to device
+    assert len(staged) >= 2
+    np.testing.assert_allclose(first[0].numpy(), xs[:2])
+    rest = list(it)
+    got = np.concatenate([first[0].numpy()] + [b[0].numpy() for b in rest])
+    np.testing.assert_allclose(got, xs)
+
+    # plain path unchanged
+    dl2 = io.DataLoader(DS(), batch_size=2, use_buffer_reader=False)
+    b0 = next(iter(dl2))
+    np.testing.assert_allclose(b0[0].numpy(), xs[:2])
