@@ -112,6 +112,14 @@ class DistributedTrainStep:
             pc.get("accumulate_steps") if int(pc.get(
                 "accumulate_steps", 1) or 1) > 1
             else hc.get("accumulate_steps") or self.pp)
+        # interleaved "virtual pipeline" chunks per device (reference:
+        # num_virtual_pipeline_stages in fleet pp_layers)
+        self.vpp = int(hc.get("virtual_pp_degree")
+                       or pc.get("num_virtual_pipeline_stages") or 1)
+        if self.vpp > 1 and self.n_microbatches < self.pp:
+            raise ValueError(
+                f"virtual_pp_degree>1 needs accumulate_steps "
+                f"({self.n_microbatches}) >= pp_degree ({self.pp})")
         self._pp_state = None  # (outer_named, blocks, leaf_names, decomp)
         self._stacked = None   # {leaf_name: [pp, L/pp, ...] array}
         self._model_stale = False
@@ -127,10 +135,11 @@ class DistributedTrainStep:
             return self._pp_state
         decomp = self.model.pipeline_decompose()
         blocks = decomp["blocks"]
-        if len(blocks) % self.pp != 0:
+        if len(blocks) % (self.pp * self.vpp) != 0:
             raise ValueError(
                 f"{len(blocks)} pipeline blocks do not divide into "
-                f"pp_degree={self.pp} stages")
+                f"pp_degree={self.pp} x virtual_pp_degree={self.vpp} "
+                "virtual stages")
         for b in blocks:
             if list(b.named_buffers()):
                 raise ValueError(
@@ -165,16 +174,32 @@ class DistributedTrainStep:
             specs[ln] = P(*spec)
         return specs
 
+    def _block_order(self, n_blocks):
+        """Block index for each stacked row, flattened [pp, lps].
+
+        GPipe (vpp==1): identity.  Interleaved: device p's rows hold chunks
+        v=0..vpp-1 of lpc layers each, chunk v covering global virtual stage
+        v*pp + p — i.e. row (p, j) ← block (j//lpc*pp + p)*lpc + j%lpc."""
+        pp, vpp = self.pp, self.vpp
+        lps = n_blocks // pp
+        if vpp == 1:
+            return list(range(n_blocks))
+        lpc = lps // vpp
+        return [(j // lpc * pp + p) * lpc + j % lpc
+                for p in range(pp) for j in range(lps)]
+
     def _stack_blocks(self, blocks, leaf_names):
-        """Stack per-block params into [pp, layers_per_stage, ...] leaves."""
+        """Stack per-block params into [pp, layers_per_stage, ...] leaves
+        (rows permuted per _block_order for the interleaved schedule)."""
         pp = self.pp
         lps = len(blocks) // pp
         mesh = mesh_mod.get_mesh()
         specs = self._stacked_specs(blocks, leaf_names)
         block_params = [dict(b.named_parameters()) for b in blocks]
+        order = self._block_order(len(blocks))
         stacked = {}
         for ln in leaf_names:
-            arrs = [bp[ln]._array for bp in block_params]
+            arrs = [block_params[i][ln]._array for i in order]
             leaf = jnp.stack(arrs).reshape((pp, lps) + arrs[0].shape)
             stacked[ln] = jax.device_put(
                 leaf, NamedSharding(mesh, specs[ln]))
@@ -192,11 +217,12 @@ class DistributedTrainStep:
             return
         outer_named, blocks, leaf_names, _ = self._pp_split()
         block_params = [dict(b.named_parameters()) for b in blocks]
+        order = self._block_order(len(blocks))
         for ln in leaf_names:
             leaf = self._stacked[ln]
             flat = leaf.reshape((len(blocks),) + leaf.shape[2:])
-            for i, bp in enumerate(block_params):
-                bp[ln]._inplace_assign(flat[i])
+            for j, i in enumerate(order):
+                block_params[i][ln]._inplace_assign(flat[j])
         self._model_stale = False
 
     # ------------------------------------------------------------ shardings
@@ -331,7 +357,7 @@ class DistributedTrainStep:
                     x_mb, NamedSharding(mesh, P(None, "dp")))
             y_mb = pipeline_apply_hybrid(
                 block_apply, stacked, x_mb, rng, mesh,
-                n_stages=self.pp, n_microbatches=M)
+                n_stages=self.pp, n_microbatches=M, n_chunks=self.vpp)
             y = y_mb.reshape((B,) + y_mb.shape[2:])
             return decomp["post"](Tensor._from_array(y))
 

@@ -154,11 +154,125 @@ def gpipe_hybrid(block_apply, n_stages, n_microbatches, axis_name="pp"):
     return pipelined
 
 
+def bubble_fraction(n_stages, n_microbatches, n_chunks=1):
+    """Idle fraction of the pipeline schedule (per direction).
+
+    GPipe (n_chunks=1): (P-1)/(M+P-1).  Interleaved/circular schedule with V
+    chunks per device: (P-1)/(V*M+P-1) — the bubble shrinks by ~V because
+    each schedule step does 1/V of a device's layers (reference analog:
+    Megatron/Fleet interleaved 1F1B "virtual pipeline" stages).
+    """
+    P_, M, V = n_stages, n_microbatches, n_chunks
+    return (P_ - 1) / (V * M + P_ - 1)
+
+
+def interleaved_hybrid(block_apply, n_stages, n_microbatches, n_chunks,
+                       axis_name="pp"):
+    """Interleaved (circular) pipeline schedule — the TPU-SPMD analog of
+    Megatron/Fleet's interleaved 1F1B "virtual pipeline stages" (reference:
+    python/paddle/distributed/fleet/meta_parallel/pp_utils +
+    num_virtual_pipeline_stages in pp_layers).
+
+    Each device holds V=n_chunks non-contiguous virtual stages (chunk v on
+    device p covers global virtual stage v*P+p); a microbatch travels around
+    the ring V times.  Per schedule step a device runs layers_per_chunk =
+    L/(P*V) layers, so the warm-up/drain bubble is (P-1) steps of 1/V the
+    work: bubble fraction (P-1)/(V*M+P-1) vs GPipe's (P-1)/(M+P-1).  The
+    backward schedule (and its identically shrunken bubble) is derived by
+    jax.grad of the scan — no hand-written 1F1B bookkeeping.
+
+    Schedule: device p is active for (chunk v, microbatch m) at step
+    t = v*M + m + p.  Ring-rotation via ppermute each step; the stage
+    P-1 → stage 0 wrap between consecutive chunks needs activations delayed
+    D = M - P steps, held in a small ring FIFO (requires M >= P).
+
+    stacked-leaf layout per device: [V*layers_per_chunk, ...] with chunk v
+    occupying rows [v*lpc, (v+1)*lpc).
+    """
+    P_, M, V = n_stages, n_microbatches, n_chunks
+    if M < P_:
+        raise ValueError(
+            f"interleaved schedule needs microbatches ({M}) >= stages ({P_})")
+    D = M - P_           # stage-(P-1) → stage-0 inter-chunk delay
+    T = V * M + P_ - 1   # total schedule steps
+
+    def pipelined(stacked_params, x_mb, key):
+        # under shard_map the pp axis is manual: leading dim == 1 here
+        my_params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+        n_rows = jax.tree_util.tree_leaves(my_params)[0].shape[0]
+        lpc = n_rows // V
+        idx = lax.axis_index(axis_name)
+        key = jax.random.fold_in(key, idx)
+        mb_shape = x_mb.shape[1:]
+
+        out_buf = jnp.zeros((M,) + mb_shape, x_mb.dtype)
+        state = jnp.zeros(mb_shape, x_mb.dtype)
+        fifo = jnp.zeros((D + 1,) + mb_shape, x_mb.dtype)
+
+        def chunk_params(v):
+            return jax.tree_util.tree_map(
+                lambda a: lax.dynamic_slice_in_dim(a, v * lpc, lpc, 0),
+                my_params)
+
+        def stage_fn(cparams, x, v, k):
+            def scan_block(h, xs):
+                layer_params, li = xs
+                kk = jax.random.fold_in(k, v * lpc + li)
+                return block_apply(layer_params, h, kk), None
+
+            y, _ = lax.scan(scan_block, x, (cparams, jnp.arange(lpc)))
+            return y
+
+        def body(carry, t):
+            state, out_buf, fifo = carry
+            rel = t - idx
+            v = jnp.clip(rel // M, 0, V - 1)
+            m = jnp.clip(rel % M, 0, M - 1)
+            # stage-0 inter-chunk FIFO: read the activation pushed D steps
+            # ago (slot (t+1) % (D+1) == (t-D) % (D+1)), then push this
+            # step's arrival
+            if D > 0:
+                delayed = lax.dynamic_index_in_dim(
+                    fifo, (t + 1) % (D + 1), 0, keepdims=False)
+                fifo = lax.dynamic_update_index_in_dim(
+                    fifo, state, t % (D + 1), 0)
+            else:
+                delayed = state
+            inject = x_mb[m]
+            h0 = jnp.where(v == 0, inject, delayed)
+            h = jnp.where(idx == 0, h0, state)
+            y = stage_fn(chunk_params(v), h, v, jax.random.fold_in(key, t))
+            m_emit = jnp.clip(t - (V - 1) * M - (P_ - 1), 0, M - 1)
+            is_emit = (idx == P_ - 1) & (t >= (V - 1) * M + P_ - 1)
+            prev = lax.dynamic_index_in_dim(out_buf, m_emit, 0,
+                                            keepdims=False)
+            out_buf = lax.dynamic_update_index_in_dim(
+                out_buf, jnp.where(is_emit, y, prev), m_emit, 0)
+            perm = [(i, (i + 1) % P_) for i in range(P_)]
+            state = lax.ppermute(y, axis_name, perm)
+            return (state, out_buf, fifo), None
+
+        (state, out_buf, fifo), _ = lax.scan(
+            body, (state, out_buf, fifo), jnp.arange(T))
+        out = lax.psum(
+            jnp.where(idx == P_ - 1, out_buf,
+                      jnp.zeros_like(out_buf)), axis_name)
+        return out[None]
+
+    return pipelined
+
+
 def pipeline_apply_hybrid(block_apply, stacked_params, x_mb, key, mesh,
-                          n_stages, n_microbatches, axis_name="pp"):
-    """Run the hybrid GPipe schedule; must be called inside jit (the fleet
-    engine's pjit step).  x_mb: [M, mb, ...]; returns [M, mb, ...]."""
-    fn = gpipe_hybrid(block_apply, n_stages, n_microbatches, axis_name)
+                          n_stages, n_microbatches, axis_name="pp",
+                          n_chunks=1):
+    """Run the hybrid pipeline schedule (GPipe, or interleaved when
+    n_chunks > 1); must be called inside jit (the fleet engine's pjit
+    step).  x_mb: [M, mb, ...]; returns [M, mb, ...]."""
+    if n_chunks > 1:
+        fn = interleaved_hybrid(block_apply, n_stages, n_microbatches,
+                                n_chunks, axis_name)
+    else:
+        fn = gpipe_hybrid(block_apply, n_stages, n_microbatches, axis_name)
     param_specs = jax.tree_util.tree_map(
         lambda _: P(axis_name), stacked_params)
     mapped = jax.shard_map(fn, mesh=mesh,

@@ -26,10 +26,15 @@ def _mode():
     if env == "interpret":
         return "interpret"
     try:
-        backend = jax.default_backend()
+        dev = jax.devices()[0]
     except Exception:  # pragma: no cover
         return None
-    return "tpu" if backend == "tpu" else None
+    # PJRT plugins may register under their own platform name (e.g. the
+    # axon tunnel) while still exposing TPU devices — key off the device,
+    # not the backend label.
+    kind = (getattr(dev, "device_kind", "") or "").lower()
+    plat = (getattr(dev, "platform", "") or "").lower()
+    return "tpu" if ("tpu" in kind or plat in ("tpu", "axon")) else None
 
 
 _xla_sdpa = get("sdpa").fn
