@@ -32,6 +32,10 @@ from . import linalg  # noqa: F401
 from . import static  # noqa: F401
 from . import profiler  # noqa: F401
 from . import utils  # noqa: F401
+from . import metric  # noqa: F401
+from . import hapi  # noqa: F401
+from . import callbacks  # noqa: F401
+from .hapi import Model  # noqa: F401
 from .framework.random import get_rng_state, set_rng_state  # noqa: F401
 from .framework import checkpoint  # noqa: F401
 from .framework.checkpoint import save_state, load_state  # noqa: F401

@@ -200,6 +200,10 @@ def interleaved_hybrid(block_apply, n_stages, n_microbatches, n_chunks,
         # under shard_map the pp axis is manual: leading dim == 1 here
         my_params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
         n_rows = jax.tree_util.tree_leaves(my_params)[0].shape[0]
+        if n_rows % V:
+            raise ValueError(
+                f"per-device layer rows ({n_rows}) not divisible by "
+                f"n_chunks ({V})")
         lpc = n_rows // V
         idx = lax.axis_index(axis_name)
         key = jax.random.fold_in(key, idx)

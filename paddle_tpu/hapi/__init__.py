@@ -1,0 +1,386 @@
+"""High-level training API (reference: python/paddle/hapi/model.py —
+paddle.Model with prepare/fit/evaluate/predict/save/load).
+
+TPU-native: ``fit`` drives the fully-fused jit train step (fwd+bwd+opt in
+one donated XLA program) and the async device-buffered DataLoader, so the
+high-level API gets the performance path by default; ``evaluate``/
+``predict`` run a jit-compiled forward.  Metrics follow the reference's
+device-compute + host-accumulate split (see paddle_tpu.metric).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import io as _io
+from ..metric import Metric
+from ..tensor import Tensor
+from . import callbacks as callbacks_mod
+from .callbacks import Callback, CallbackList, ProgBarLogger, ModelCheckpoint
+
+__all__ = ["Model"]
+
+
+def _to_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor._from_array_any(x) if hasattr(Tensor, "_from_array_any") \
+        else Tensor(np.asarray(x))
+
+
+class Model:
+    """model = Model(network); model.prepare(opt, loss, metrics);
+    model.fit(train_ds, eval_ds, epochs=E, batch_size=B)."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self._eval_fn = None
+        self._pred_fn = None
+        self.stop_training = False
+        self._save_dir = None
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            ms = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+            for m in ms:
+                if not isinstance(m, Metric):
+                    raise TypeError(f"metric {m!r} is not a Metric")
+            self._metrics = list(ms)
+        self._amp_configs = amp_configs
+        self._train_step = None     # rebuilt lazily
+        self._eval_fn = None
+        self._pred_fn = None
+        return self
+
+    # ----------------------------------------------------------- internals
+    def _split_batch(self, batch):
+        """(inputs..., label) — single trailing label by default, matching
+        the common reference usage; multi-label via `labels` spec length."""
+        if not isinstance(batch, (list, tuple)):
+            batch = (batch,)
+        n_lab = len(self._labels) if self._labels else 1
+        if self._loss is None and not self._metrics:
+            return tuple(batch), ()
+        return tuple(batch[:-n_lab]), tuple(batch[-n_lab:])
+
+    def _loss_value(self, pred, labels):
+        out = self._loss(pred, *labels)
+        return out
+
+    def _ensure_train_step(self):
+        if self._train_step is not None:
+            return
+        if self._optimizer is None or self._loss is None:
+            raise RuntimeError("call prepare(optimizer=..., loss=...) "
+                               "before training")
+        from ..jit.train_step import train_step as _make_train_step
+
+        def loss_fn(network, *batch):
+            inputs, labels = self._split_batch(batch)
+            pred = network(*inputs)
+            return self._loss_value(pred, labels)
+
+        self._train_step = _make_train_step(self.network, loss_fn,
+                                            self._optimizer)
+
+    def _ensure_eval_fn(self):
+        if self._eval_fn is not None:
+            return
+        from ..jit import functional_bridge as FB
+        import jax
+
+        network, loss, metrics = self.network, self._loss, self._metrics
+
+        def eval_fn(param_arrays, buffer_arrays, batch_arrays):
+            def fwd(*ts):
+                inputs, labels = self._split_batch(ts)
+                pred = network(*inputs)
+                outs = {}
+                if loss is not None:
+                    outs["loss"] = self._loss_value(pred, labels)._array
+                for i, m in enumerate(metrics):
+                    outs[f"m{i}"] = m.compute(pred, *labels)
+                return outs
+            out, _ = FB.call_functional(network, param_arrays,
+                                        buffer_arrays, batch_arrays,
+                                        rng_key=None, fn=fwd)
+            return out
+
+        self._eval_jit = jax.jit(eval_fn)
+        self._eval_fn = True
+
+    def _run_eval_batch(self, batch_arrays):
+        from ..jit import functional_bridge as FB
+        pn, pa, bn, ba = FB.split_state(self.network)
+        return self._eval_jit(pa, ba, batch_arrays)
+
+    def _ensure_pred_fn(self):
+        if self._pred_fn is not None:
+            return
+        from ..jit import functional_bridge as FB
+        import jax
+
+        network = self.network
+
+        def pred_fn(param_arrays, buffer_arrays, batch_arrays):
+            def fwd(*ts):
+                out = network(*ts)
+                if isinstance(out, (list, tuple)):
+                    return [o._array for o in out]
+                return out._array
+            out, _ = FB.call_functional(network, param_arrays,
+                                        buffer_arrays, batch_arrays,
+                                        rng_key=None, fn=fwd)
+            return out
+
+        self._pred_jit = jax.jit(pred_fn)
+        self._pred_fn = True
+
+    def _as_loader(self, data, batch_size, shuffle, num_workers, drop_last):
+        if data is None or isinstance(data, _io.DataLoader):
+            return data
+        return _io.DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers, drop_last=drop_last)
+
+    # ------------------------------------------------------------ batch API
+    def train_batch(self, inputs, labels=None):
+        self._ensure_train_step()
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = [] if labels is None else (
+            labels if isinstance(labels, (list, tuple)) else [labels])
+        batch = [b if isinstance(b, Tensor) else Tensor(np.asarray(b))
+                 for b in list(inputs) + list(labels)]
+        loss = self._train_step(*batch)
+        return float(loss)
+
+    def eval_batch(self, inputs, labels=None):
+        self._ensure_eval_fn()
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = [] if labels is None else (
+            labels if isinstance(labels, (list, tuple)) else [labels])
+        batch = tuple(
+            (b._array if isinstance(b, Tensor) else np.asarray(b))
+            for b in list(inputs) + list(labels))
+        outs = self._run_eval_batch(batch)
+        logs = {}
+        if "loss" in outs:
+            logs["loss"] = float(outs["loss"])
+        for i, m in enumerate(self._metrics):
+            res = outs[f"m{i}"]
+            m.update(*(res if isinstance(res, tuple) else (res,)))
+        return logs
+
+    def predict_batch(self, inputs):
+        self._ensure_pred_fn()
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        batch = tuple(
+            (b._array if isinstance(b, Tensor) else np.asarray(b))
+            for b in inputs)
+        out = self._pred_jit(*_split_for_pred(self.network, batch))
+        return out
+
+    # ------------------------------------------------------------------ fit
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None):
+        assert train_data is not None, "train_data is required"
+        loader = self._as_loader(train_data, batch_size, shuffle,
+                                 num_workers, drop_last)
+        eval_loader = self._as_loader(eval_data, batch_size, False,
+                                      num_workers, False)
+        self._ensure_train_step()
+        self._save_dir = save_dir
+        self.stop_training = False
+
+        cbs = list(callbacks or [])
+        if not any(isinstance(c, ProgBarLogger) for c in cbs):
+            cbs.insert(0, ProgBarLogger(log_freq, verbose))
+        if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbs):
+            cbs.append(ModelCheckpoint(save_freq, save_dir))
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cblist = CallbackList(cbs, self, {
+            "epochs": epochs, "steps": steps, "verbose": verbose})
+
+        cblist.call("on_train_begin", {})
+        history = []
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cblist.call("on_epoch_begin", epoch, {})
+            self.network.train()
+            losses = []
+            for step, batch in enumerate(loader):
+                batch = batch if isinstance(batch, (list, tuple)) else [batch]
+                cblist.call("on_train_batch_begin", step, {})
+                loss = self._train_step(*batch)
+                # keep the loss on device: a float() here would block on the
+                # async XLA dispatch every batch.  Materialize only at log
+                # boundaries; the epoch mean syncs once at epoch end.
+                losses.append(loss._array)
+                logs = {"loss": float(loss)} \
+                    if (step + 1) % log_freq == 0 else {}
+                cblist.call("on_train_batch_end", step, logs)
+            epoch_logs = {"loss": float(np.mean([np.asarray(a)
+                                                 for a in losses]))
+                          if losses else 0.0}
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, batch_size=batch_size,
+                                          verbose=0, callbacks=cbs,
+                                          _cblist=cblist)
+                epoch_logs.update({f"eval_{k}": v
+                                   for k, v in eval_logs.items()})
+            cblist.call("on_epoch_end", epoch, epoch_logs)
+            history.append(epoch_logs)
+        cblist.call("on_train_end", {})
+        return history
+
+    # ------------------------------------------------------------- evaluate
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, _cblist=None):
+        loader = self._as_loader(eval_data, batch_size, False,
+                                 num_workers, False)
+        self._ensure_eval_fn()
+        cblist = _cblist or CallbackList(
+            list(callbacks or [ProgBarLogger(log_freq, verbose)]), self,
+            {"epochs": 0, "steps": None, "verbose": verbose})
+        for m in self._metrics:
+            m.reset()
+        cblist.call("on_eval_begin", {})
+        self.network.eval()
+        losses = []
+        for step, batch in enumerate(loader):
+            batch = batch if isinstance(batch, (list, tuple)) else [batch]
+            cblist.call("on_eval_batch_begin", step, {})
+            arrays = tuple(
+                (b._array if isinstance(b, Tensor) else np.asarray(b))
+                for b in batch)
+            outs = self._run_eval_batch(arrays)
+            logs = {}
+            if "loss" in outs:
+                logs["loss"] = float(outs["loss"])
+                losses.append(logs["loss"])
+            for i, m in enumerate(self._metrics):
+                res = outs[f"m{i}"]
+                m.update(*(res if isinstance(res, tuple) else (res,)))
+            cblist.call("on_eval_batch_end", step, logs)
+        result = {}
+        if losses:
+            result["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            names = m.name()
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, (list, tuple)) else [vals]
+            result.update(dict(zip(names, vals)))
+        cblist.call("on_eval_end", result)
+        return result
+
+    # -------------------------------------------------------------- predict
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._as_loader(test_data, batch_size, False,
+                                 num_workers, False)
+        self._ensure_pred_fn()
+        from ..jit import functional_bridge as FB
+        self.network.eval()
+        outputs = []
+        cblist = CallbackList(list(callbacks or []), self,
+                              {"epochs": 0, "steps": None,
+                               "verbose": verbose})
+        cblist.call("on_predict_begin", {})
+        for step, batch in enumerate(loader):
+            batch = batch if isinstance(batch, (list, tuple)) else [batch]
+            arrays = tuple(
+                (b._array if isinstance(b, Tensor) else np.asarray(b))
+                for b in batch)
+            pn, pa, bn, ba = FB.split_state(self.network)
+            out = self._pred_jit(pa, ba, arrays)
+            out = np.asarray(out) if not isinstance(out, list) \
+                else [np.asarray(o) for o in out]
+            outputs.append(out)
+            cblist.call("on_predict_batch_end", step, {})
+        cblist.call("on_predict_end", {})
+        if stack_outputs and outputs:
+            if isinstance(outputs[0], list):
+                # multi-output network: concat each field across batches
+                return [np.concatenate([o[i] for o in outputs], 0)
+                        for i in range(len(outputs[0]))]
+            return [np.concatenate(outputs, 0)]
+        return outputs
+
+    # ------------------------------------------------------------ save/load
+    def save(self, path, training=True):
+        if training:
+            from ..framework import checkpoint as ckpt
+            ckpt.save_state(path, model=self.network,
+                            optimizer=self._optimizer)
+        else:
+            from .. import jit as _jit
+            _jit.save(self.network, path)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework import checkpoint as ckpt
+        target = self.network
+        if skip_mismatch:
+            target = _SkipMismatchShim(self.network)
+        ckpt.load_state(path, model=target,
+                        optimizer=None if reset_optimizer
+                        else self._optimizer)
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(int(np.prod(p.shape))
+                       for p in self.network.parameters())
+        lines = [f"{type(self.network).__name__}: "
+                 f"{n_params:,} parameters"]
+        for name, layer in self.network.named_sublayers():
+            ps = sum(int(np.prod(p.shape)) for p in layer.parameters(
+                include_sublayers=False))
+            if ps:
+                lines.append(f"  {name} ({type(layer).__name__}): {ps:,}")
+        s = "\n".join(lines)
+        print(s)
+        return {"total_params": n_params}
+
+
+def _split_for_pred(network, batch):
+    from ..jit import functional_bridge as FB
+    pn, pa, bn, ba = FB.split_state(network)
+    return pa, ba, batch
+
+
+class _SkipMismatchShim:
+    """load_state target that drops checkpoint entries whose name or shape
+    doesn't match the network (Model.load(skip_mismatch=True))."""
+
+    def __init__(self, network):
+        self._network = network
+
+    def set_state_dict(self, state_dict):
+        cur = self._network.state_dict()
+        keep = {}
+        for k, v in state_dict.items():
+            if k not in cur:
+                continue
+            shape = tuple(v.shape) if hasattr(v, "shape") \
+                else tuple(np.asarray(v).shape)
+            if shape == tuple(cur[k].shape):
+                keep[k] = v
+        self._network.set_state_dict(keep)

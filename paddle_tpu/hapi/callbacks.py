@@ -1,0 +1,170 @@
+"""Training callbacks (reference: python/paddle/hapi/callbacks.py —
+Callback/ProgBarLogger/ModelCheckpoint/EarlyStopping/LRScheduler).
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+           "LRScheduler"]
+
+
+class Callback:
+    """No-op base; fit/evaluate/predict drive these hooks."""
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None): ...
+    def on_train_end(self, logs=None): ...
+    def on_eval_begin(self, logs=None): ...
+    def on_eval_end(self, logs=None): ...
+    def on_predict_begin(self, logs=None): ...
+    def on_predict_end(self, logs=None): ...
+    def on_epoch_begin(self, epoch, logs=None): ...
+    def on_epoch_end(self, epoch, logs=None): ...
+    def on_train_batch_begin(self, step, logs=None): ...
+    def on_train_batch_end(self, step, logs=None): ...
+    def on_eval_batch_begin(self, step, logs=None): ...
+    def on_eval_batch_end(self, step, logs=None): ...
+    def on_predict_batch_begin(self, step, logs=None): ...
+    def on_predict_batch_end(self, step, logs=None): ...
+
+
+class CallbackList:
+    def __init__(self, callbacks, model, params):
+        self.callbacks = list(callbacks)
+        for c in self.callbacks:
+            c.set_model(model)
+            c.set_params(params)
+
+    def call(self, hook, *args):
+        for c in self.callbacks:
+            getattr(c, hook)(*args)
+
+
+class ProgBarLogger(Callback):
+    """Per-epoch progress logging (compact line-based; reference prints a
+    progress bar — line logs are terminal-agnostic and CI-friendly)."""
+
+    def __init__(self, log_freq=10, verbose=2):
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self._t0 = time.time()
+        if self.verbose >= 1:
+            print(f"Epoch {epoch + 1}/{self.params['epochs']}")
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose >= 2 and (step + 1) % self.log_freq == 0:
+            items = " - ".join(f"{k}: {v:.4f}" if isinstance(v, float)
+                               else f"{k}: {v}"
+                               for k, v in (logs or {}).items())
+            print(f"  step {step + 1}/{self.params.get('steps', '?')}"
+                  f" - {items}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose >= 1:
+            items = " - ".join(f"{k}: {v:.4f}" if isinstance(v, float)
+                               else f"{k}: {v}"
+                               for k, v in (logs or {}).items())
+            print(f"  epoch {epoch + 1} done in "
+                  f"{time.time() - self._t0:.1f}s - {items}")
+
+    def on_eval_end(self, logs=None):
+        if self.verbose >= 1:
+            items = " - ".join(
+                f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
+                for k, v in (logs or {}).items())
+            print(f"  eval - {items}")
+
+
+class ModelCheckpoint(Callback):
+    """Save `{save_dir}/{epoch}` every save_freq epochs + `final` at end."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and (epoch + 1) % self.save_freq == 0:
+            self.model.save(os.path.join(self.save_dir, str(epoch)))
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class EarlyStopping(Callback):
+    """Stop fit() when a monitored metric stops improving."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0,
+                 min_delta=0, baseline=None, save_best_model=True):
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.best = None
+        self.wait = 0
+        self.stopped_epoch = -1
+
+    def _better(self, cur, ref):
+        if self.mode == "min":
+            return cur < ref - self.min_delta
+        return cur > ref + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(np.asarray(cur).reshape(-1)[0]) \
+            if not isinstance(cur, (int, float)) else float(cur)
+        ref = self.best if self.best is not None else self.baseline
+        if ref is None or self._better(cur, ref):
+            self.best = cur
+            self.wait = 0
+            if self.save_best_model and getattr(self.model, "_save_dir",
+                                                None):
+                self.model.save(os.path.join(self.model._save_dir,
+                                             "best_model"))
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.model.stop_training = True
+
+
+class LRScheduler(Callback):
+    """Step the optimizer's LRScheduler each epoch (or each batch)."""
+
+    def __init__(self, by_step=False, by_epoch=True):
+        self.by_step = by_step
+        self.by_epoch = by_epoch and not by_step
+
+    def _sched(self):
+        opt = self.model._optimizer
+        lr = getattr(opt, "_lr", None) or getattr(opt, "_learning_rate",
+                                                  None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s is not None:
+            s.step()

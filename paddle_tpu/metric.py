@@ -1,0 +1,180 @@
+"""Evaluation metrics (reference: python/paddle/metric/metrics.py —
+Metric/Accuracy/Precision/Recall/Auc).
+
+TPU-native split: ``compute()`` runs inside the jitted eval step (pure
+jnp on device — batched correctness/statistics), ``update()`` accumulates
+the small host-side result.  This mirrors the reference's graph-side
+compute + host-side accumulate design while keeping the eval loop one
+XLA program.
+"""
+from __future__ import annotations
+
+import abc
+
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._array
+    return jnp.asarray(x)
+
+
+class Metric(abc.ABC):
+    """Base metric: compute (device) -> update (host) -> accumulate."""
+
+    def compute(self, pred, label, *args):
+        """Device-side preprocessing; default passthrough."""
+        return pred, label
+
+    @abc.abstractmethod
+    def update(self, *args):
+        ...
+
+    @abc.abstractmethod
+    def accumulate(self):
+        ...
+
+    @abc.abstractmethod
+    def reset(self):
+        ...
+
+    @abc.abstractmethod
+    def name(self):
+        ...
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (reference: paddle.metric.Accuracy)."""
+
+    def __init__(self, topk=(1,), name=None):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred, label = _arr(pred), _arr(label)
+        if label.ndim == pred.ndim and label.shape[-1] == 1:
+            label = label[..., 0]
+        k = max(self.topk)
+        topk_idx = jnp.argsort(pred, axis=-1)[..., ::-1][..., :k]
+        correct = (topk_idx == label[..., None])
+        return correct
+
+    def update(self, correct):
+        correct = np.asarray(correct)
+        n = int(np.prod(correct.shape[:-1]))
+        for i, k in enumerate(self.topk):
+            self._correct[i] += float(correct[..., :k].any(-1).sum())
+        self._count += n
+        hit = correct[..., :self.topk[0]].any(-1)
+        return float(hit.mean())
+
+    def accumulate(self):
+        vals = [c / max(self._count, 1) for c in self._correct]
+        return vals[0] if len(vals) == 1 else vals
+
+    def reset(self):
+        self._correct = [0.0] * len(self.topk)
+        self._count = 0
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision: tp / (tp + fp) over thresholded predictions."""
+
+    def __init__(self, name="precision"):
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.asarray(_arr(preds)).reshape(-1)
+        labels = np.asarray(_arr(labels)).reshape(-1)
+        hard = (preds > 0.5).astype(np.int64)
+        self.tp += int(((hard == 1) & (labels == 1)).sum())
+        self.fp += int(((hard == 1) & (labels == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def name(self):
+        return [self._name]
+
+
+class Recall(Metric):
+    """Binary recall: tp / (tp + fn)."""
+
+    def __init__(self, name="recall"):
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.asarray(_arr(preds)).reshape(-1)
+        labels = np.asarray(_arr(labels)).reshape(-1)
+        hard = (preds > 0.5).astype(np.int64)
+        self.tp += int(((hard == 1) & (labels == 1)).sum())
+        self.fn += int(((hard == 0) & (labels == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def name(self):
+        return [self._name]
+
+
+class Auc(Metric):
+    """ROC-AUC via the reference's histogram-bucket approximation
+    (num_thresholds buckets of positive/negative counts)."""
+
+    def __init__(self, num_thresholds=4095, name="auc"):
+        self.num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.asarray(_arr(preds))
+        labels = np.asarray(_arr(labels)).reshape(-1)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        idx = np.clip((preds * self.num_thresholds).astype(np.int64),
+                      0, self.num_thresholds - 1)
+        np.add.at(self._pos, idx, labels == 1)
+        np.add.at(self._neg, idx, labels == 0)
+
+    def accumulate(self):
+        # sweep thresholds high->low accumulating tp/fp; trapezoidal area
+        tp = np.cumsum(self._pos[::-1])
+        fp = np.cumsum(self._neg[::-1])
+        tot_p, tot_n = tp[-1], fp[-1]
+        if tot_p == 0 or tot_n == 0:
+            return 0.0
+        tpr = np.concatenate([[0.0], tp / tot_p])
+        fpr = np.concatenate([[0.0], fp / tot_n])
+        return float(np.trapezoid(tpr, fpr))
+
+    def reset(self):
+        self._pos = np.zeros(self.num_thresholds, np.int64)
+        self._neg = np.zeros(self.num_thresholds, np.int64)
+
+    def name(self):
+        return [self._name]

@@ -30,7 +30,8 @@ def _coerce(data, dtype=None):
         if arr.dtype == np.float64:
             d = dtypes.get_default_dtype()
         elif arr.dtype == np.int64:
-            d = dtypes.int64
+            # route through the 64->32 policy so x64-off never warns
+            d = dtypes.convert_dtype("int64")
     return jnp.asarray(arr, dtype=d)
 
 

@@ -1,0 +1,217 @@
+"""High-level Model API (reference: python/paddle/hapi/model.py) +
+paddle.metric metrics."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.io import Dataset
+
+
+class _XorDataset(Dataset):
+    """Tiny separable problem: 2-class blobs."""
+
+    def __init__(self, n=64, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, 8).astype(np.float32)
+        w = np.random.RandomState(42).randn(8)  # same task across splits
+        self.y = (self.x @ w > 0).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _mlp():
+    pt.seed(0)
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+
+
+def _prepared_model(lr=0.05):
+    m = pt.Model(_mlp())
+    m.prepare(optimizer=pt.optimizer.Adam(
+        learning_rate=lr, parameters=m.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=pt.metric.Accuracy())
+    return m
+
+
+def test_fit_reduces_loss_and_evaluate_accuracy():
+    model = _prepared_model()
+    train = _XorDataset(128, seed=1)
+    test = _XorDataset(64, seed=2)
+    hist = model.fit(train, epochs=6, batch_size=32, verbose=0)
+    assert hist[-1]["loss"] < hist[0]["loss"] * 0.7
+    res = model.evaluate(test, batch_size=32, verbose=0)
+    assert set(res) >= {"loss", "acc"}
+    assert res["acc"] > 0.8
+
+
+def test_fit_with_eval_data_and_history():
+    model = _prepared_model()
+    hist = model.fit(_XorDataset(64), eval_data=_XorDataset(32, seed=3),
+                     epochs=2, batch_size=16, verbose=0)
+    assert len(hist) == 2
+    assert "eval_acc" in hist[-1]
+
+
+def test_predict_shapes_and_stack():
+    model = _prepared_model()
+    test = _XorDataset(40, seed=4)
+    xs = [(test.x[i],) for i in range(40)]
+
+    class _XOnly(Dataset):
+        def __getitem__(self, i):
+            return xs[i]
+
+        def __len__(self):
+            return len(xs)
+
+    outs = model.predict(_XOnly(), batch_size=16, stack_outputs=True)
+    assert outs[0].shape == (40, 2)
+
+
+def test_train_eval_predict_batch():
+    model = _prepared_model()
+    x = np.random.randn(8, 8).astype(np.float32)
+    y = np.random.randint(0, 2, (8,))
+    l0 = model.train_batch([x], [y])
+    assert isinstance(l0, float)
+    logs = model.eval_batch([x], [y])
+    assert "loss" in logs
+    out = model.predict_batch([x])
+    assert np.asarray(out).shape == (8, 2)
+
+
+def test_early_stopping_and_checkpoint(tmp_path):
+    model = _prepared_model(lr=0.0)  # lr=0 -> no improvement -> stops
+    es = pt.callbacks.EarlyStopping(monitor="loss", mode="min", patience=1,
+                                    save_best_model=False)
+    hist = model.fit(_XorDataset(32), eval_data=_XorDataset(32, seed=5),
+                     epochs=8, batch_size=16, verbose=0, callbacks=[es])
+    assert len(hist) < 8  # stopped early
+
+    model2 = _prepared_model()
+    model2.fit(_XorDataset(32), epochs=1, batch_size=16, verbose=0,
+               save_dir=str(tmp_path / "ckpt"))
+    assert (tmp_path / "ckpt" / "final").exists()
+    model3 = _prepared_model()
+    model3.load(str(tmp_path / "ckpt" / "final"))
+    p2 = model2.network.state_dict()
+    p3 = model3.network.state_dict()
+    for k in p2:
+        np.testing.assert_allclose(p2[k].numpy(), p3[k].numpy(), rtol=1e-6)
+
+
+def test_lr_scheduler_callback():
+    net = _mlp()
+    sched = pt.optimizer.lr.StepDecay(learning_rate=0.1, step_size=1,
+                                      gamma=0.5)
+    opt = pt.optimizer.SGD(learning_rate=sched, parameters=net.parameters())
+    model = pt.Model(net)
+    model.prepare(optimizer=opt, loss=nn.CrossEntropyLoss())
+    model.fit(_XorDataset(32), epochs=2, batch_size=16, verbose=0,
+              callbacks=[pt.callbacks.LRScheduler()])
+    assert opt.get_lr() == pytest.approx(0.1 * 0.5 ** 2)
+
+
+def test_metric_accuracy_topk():
+    acc = pt.metric.Accuracy(topk=(1, 2))
+    pred = np.array([[0.1, 0.7, 0.2], [0.8, 0.15, 0.05]], np.float32)
+    label = np.array([1, 2])
+    correct = acc.compute(pt.to_tensor(pred), pt.to_tensor(label))
+    acc.update(np.asarray(correct))
+    top1, top2 = acc.accumulate()
+    assert top1 == pytest.approx(0.5)
+    assert top2 == pytest.approx(0.5)
+    assert acc.name() == ["acc_top1", "acc_top2"]
+
+
+def test_metric_precision_recall():
+    p, r = pt.metric.Precision(), pt.metric.Recall()
+    preds = np.array([0.9, 0.8, 0.2, 0.6])
+    labels = np.array([1, 0, 1, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert p.accumulate() == pytest.approx(2 / 3)
+    assert r.accumulate() == pytest.approx(2 / 3)
+
+
+def test_metric_auc_perfect_and_random():
+    auc = pt.metric.Auc()
+    preds = np.array([0.9, 0.8, 0.1, 0.2])
+    labels = np.array([1, 1, 0, 0])
+    auc.update(preds, labels)
+    assert auc.accumulate() == pytest.approx(1.0, abs=1e-3)
+    auc.reset()
+    auc.update(np.array([0.6, 0.6, 0.6, 0.6]), labels)
+    assert auc.accumulate() == pytest.approx(0.5, abs=1e-2)
+
+
+def test_model_summary(capsys):
+    model = _prepared_model()
+    info = model.summary()
+    out = capsys.readouterr().out
+    assert "parameters" in out
+    assert info["total_params"] == 8 * 32 + 32 + 32 * 2 + 2
+
+
+def test_evaluate_metrics_without_loss():
+    """prepare(metrics=...) without a loss still splits off the label."""
+    model = pt.Model(_mlp())
+    model.prepare(metrics=pt.metric.Accuracy())
+    res = model.evaluate(_XorDataset(32, seed=6), batch_size=16, verbose=0)
+    assert "acc" in res and "loss" not in res
+
+
+def test_load_skip_mismatch(tmp_path):
+    model = _prepared_model()
+    model.save(str(tmp_path / "m"))
+    pt.seed(1)
+    bigger = pt.Model(nn.Sequential(nn.Linear(8, 32), nn.ReLU(),
+                                    nn.Linear(32, 4)))  # head differs
+    bigger.prepare(optimizer=pt.optimizer.Adam(
+        learning_rate=0.1, parameters=bigger.parameters()),
+        loss=nn.CrossEntropyLoss())
+    before = bigger.network.state_dict()["2.weight"].numpy().copy()
+    bigger.load(str(tmp_path / "m"), skip_mismatch=True,
+                reset_optimizer=True)
+    after = bigger.network.state_dict()
+    # matching first layer restored, mismatched head untouched
+    np.testing.assert_allclose(
+        after["0.weight"].numpy(),
+        model.network.state_dict()["0.weight"].numpy(), rtol=1e-6)
+    np.testing.assert_allclose(after["2.weight"].numpy(), before)
+
+
+def test_predict_multi_output_stack():
+    class TwoHead(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(8, 2)
+            self.b = nn.Linear(8, 3)
+
+        def forward(self, x):
+            return self.a(x), self.b(x)
+
+    class _X(Dataset):
+        def __getitem__(self, i):
+            return (np.random.RandomState(i).randn(8).astype(np.float32),)
+
+        def __len__(self):
+            return 20
+
+    pt.seed(0)
+    model = pt.Model(TwoHead())
+    model.prepare()
+    outs = model.predict(_X(), batch_size=8, stack_outputs=True)
+    assert len(outs) == 2
+    assert outs[0].shape == (20, 2) and outs[1].shape == (20, 3)
+
+
+def test_auc_negative_scores_clip_low():
+    auc = pt.metric.Auc()
+    auc.update(np.array([-0.5, -0.2, 0.9, 0.8]), np.array([0, 0, 1, 1]))
+    assert auc.accumulate() == pytest.approx(1.0, abs=1e-3)
