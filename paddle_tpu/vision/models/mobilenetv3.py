@@ -1,0 +1,123 @@
+"""MobileNetV3 small/large (reference: python/paddle/vision/models/
+mobilenetv3.py)."""
+from ... import nn
+from .mobilenetv2 import _make_divisible
+
+__all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+           "mobilenet_v3_large"]
+
+
+def _act(name):
+    return {"relu": nn.ReLU, "hardswish": nn.Hardswish}[name]()
+
+
+class _SqueezeExcite(nn.Layer):
+    def __init__(self, c, squeeze):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(c, squeeze, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(squeeze, c, 1)
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, inp, exp, out, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == out
+        layers = []
+        if exp != inp:
+            layers += [nn.Conv2D(inp, exp, 1, bias_attr=False),
+                       nn.BatchNorm2D(exp), _act(act)]
+        layers += [nn.Conv2D(exp, exp, kernel, stride=stride,
+                             padding=kernel // 2, groups=exp,
+                             bias_attr=False),
+                   nn.BatchNorm2D(exp), _act(act)]
+        if use_se:
+            layers.append(_SqueezeExcite(exp, _make_divisible(exp // 4)))
+        layers += [nn.Conv2D(exp, out, 1, bias_attr=False),
+                   nn.BatchNorm2D(out)]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        y = self.block(x)
+        return x + y if self.use_res else y
+
+
+# (kernel, exp, out, se, act, stride) per reference config tables
+_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_exp, last_c, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        sc = lambda c: _make_divisible(c * scale)  # noqa: E731
+        inp = sc(16)
+        layers = [nn.Conv2D(3, inp, 3, stride=2, padding=1,
+                            bias_attr=False),
+                  nn.BatchNorm2D(inp), nn.Hardswish()]
+        for kernel, exp, out, se, act, stride in cfg:
+            layers.append(_InvertedResidual(inp, sc(exp), sc(out), kernel,
+                                            stride, se, act))
+            inp = sc(out)
+        layers += [nn.Conv2D(inp, sc(last_exp), 1, bias_attr=False),
+                   nn.BatchNorm2D(sc(last_exp)), nn.Hardswish()]
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(sc(last_exp), last_c), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, 960, 1280, scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, 576, 1024, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    assert not pretrained, "pretrained weights unavailable offline"
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    assert not pretrained, "pretrained weights unavailable offline"
+    return MobileNetV3Large(scale=scale, **kwargs)

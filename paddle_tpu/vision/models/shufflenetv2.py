@@ -1,0 +1,118 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py)."""
+from ... import nn
+from ... import tensor_api as T
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+_STAGE_OUT = {
+    0.25: (24, 24, 48, 96, 512), 0.33: (24, 32, 64, 128, 512),
+    0.5: (24, 48, 96, 192, 1024), 1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024), 2.0: (24, 244, 488, 976, 2048),
+}
+
+
+def channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = x.reshape([b, groups, c // groups, h, w])
+    x = x.transpose([0, 2, 1, 3, 4])
+    return x.reshape([b, c, h, w])
+
+
+def _act(name):
+    return nn.Swish() if name == "swish" else nn.ReLU()
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, inp, out, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch = out // 2
+        if stride == 1:
+            assert inp == out
+            in_branch = inp // 2
+        else:
+            in_branch = inp
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_branch, in_branch, 3, stride=stride, padding=1,
+                          groups=in_branch, bias_attr=False),
+                nn.BatchNorm2D(in_branch),
+                nn.Conv2D(in_branch, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), _act(act))
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(in_branch if stride > 1 else branch, branch, 1,
+                      bias_attr=False),
+            nn.BatchNorm2D(branch), _act(act),
+            nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
+                      groups=branch, bias_attr=False),
+            nn.BatchNorm2D(branch),
+            nn.Conv2D(branch, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), _act(act))
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = T.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = T.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_repeats = (4, 8, 4)
+        c0, c1, c2, c3, c_last = _STAGE_OUT[scale]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, c0, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(c0), _act(act))
+        self.max_pool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        inp = c0
+        for reps, out in zip(stage_repeats, (c1, c2, c3)):
+            units = [_ShuffleUnit(inp, out, 2, act)]
+            units += [_ShuffleUnit(out, out, 1, act)
+                      for _ in range(reps - 1)]
+            stages.append(nn.Sequential(*units))
+            inp = out
+        self.stages = nn.LayerList(stages)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(inp, c_last, 1, bias_attr=False),
+            nn.BatchNorm2D(c_last), _act(act))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c_last, num_classes)
+
+    def forward(self, x):
+        x = self.max_pool(self.conv1(x))
+        for s in self.stages:
+            x = s(x)
+        x = self.conv_last(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def _make(scale, act="relu", name=None):
+    def f(pretrained=False, **kwargs):
+        assert not pretrained, "pretrained weights unavailable offline"
+        return ShuffleNetV2(scale=scale, act=act, **kwargs)
+    f.__name__ = name or f"shufflenet_v2_x{scale}"
+    return f
+
+
+shufflenet_v2_x0_25 = _make(0.25)
+shufflenet_v2_x0_33 = _make(0.33)
+shufflenet_v2_x0_5 = _make(0.5)
+shufflenet_v2_x1_0 = _make(1.0)
+shufflenet_v2_x1_5 = _make(1.5)
+shufflenet_v2_x2_0 = _make(2.0)
+shufflenet_v2_swish = _make(1.0, act="swish", name="shufflenet_v2_swish")

@@ -1,4 +1,6 @@
 """Model zoo tests (SURVEY §4): shapes + tiny overfit + generation."""
+import math
+
 import numpy as np
 import pytest
 
@@ -182,3 +184,58 @@ def test_ernie_to_static_inference():
     static = pt.jit.to_static(m)
     np.testing.assert_allclose(static(ids).numpy(), eager.numpy(),
                                rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("ctor,size", [
+    ("alexnet", 64), ("squeezenet1_0", 64), ("squeezenet1_1", 64),
+    ("mobilenet_v1", 32), ("mobilenet_v3_small", 32),
+    ("mobilenet_v3_large", 32), ("densenet121", 32),
+    ("shufflenet_v2_x0_25", 32), ("inception_v3", 96),
+])
+def test_new_vision_models_forward(ctor, size):
+    pt.seed(0)
+    m = getattr(pt.vision.models, ctor)(num_classes=7)
+    m.eval()
+    x = pt.randn([2, 3, size, size])
+    y = m(x)
+    assert tuple(y.shape) == (2, 7)
+
+
+def test_googlenet_train_and_eval_heads():
+    pt.seed(0)
+    m = pt.vision.models.googlenet(num_classes=5)
+    m.eval()
+    x = pt.randn([2, 3, 64, 64])
+    out, aux1, aux2 = m(x)
+    assert tuple(out.shape) == (2, 5)
+    assert tuple(aux1.shape) == (2, 5) and tuple(aux2.shape) == (2, 5)
+
+
+def test_new_model_trains_one_step():
+    import paddle_tpu.nn.functional as F
+    pt.seed(0)
+    m = pt.vision.models.mobilenet_v3_small(num_classes=4, scale=0.5)
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+
+    def loss_fn(model, x, y):
+        return F.cross_entropy(model(x), y, reduction="mean")
+
+    step = pt.jit.train_step(m, loss_fn, opt)
+    x = pt.randn([4, 3, 32, 32]); y = pt.randint(0, 4, [4])
+    l0 = float(step(x, y))
+    l1 = float(step(x, y))  # second step exercises BN buffer round-trip
+    assert math.isfinite(l0) and math.isfinite(l1)
+    assert l1 < l0  # SGD on a fixed batch must descend
+
+
+def test_feature_extractor_with_pool_contract():
+    """num_classes=0, with_pool=True -> pooled [N, C, 1, 1] features for
+    every zoo family (the with_pool kwarg must not be a silent no-op)."""
+    pt.seed(0)
+    x = pt.randn([1, 3, 64, 64])
+    for ctor, c in [("squeezenet1_1", 512), ("googlenet", 1024),
+                    ("densenet121", 1024)]:
+        m = getattr(pt.vision.models, ctor)(num_classes=0, with_pool=True)
+        m.eval()
+        y = m(x)
+        assert tuple(y.shape) == (1, c, 1, 1), (ctor, y.shape)
