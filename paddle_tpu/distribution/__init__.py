@@ -1,0 +1,452 @@
+"""Probability distributions (reference: python/paddle/distribution/*).
+
+sample() draws keys from the framework RNG stream (seed-deterministic,
+jit-safe via key_context); log_prob/entropy are pure jnp and therefore
+differentiable through the tape like any other op.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import random as _random
+from ..tensor import Tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "Multinomial", "Exponential", "Laplace",
+           "LogNormal", "Gumbel", "Gamma", "kl_divergence", "register_kl"]
+
+
+def _arr(x, dtype=jnp.float32):
+    if isinstance(x, Tensor):
+        return x._array.astype(dtype)
+    return jnp.asarray(x, dtype)
+
+
+def _wrap(a):
+    return Tensor._from_array(a)
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    return tuple(int(s) for s in shape)
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _wrap(jnp.exp(self.log_prob(value)._array))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, jnp.broadcast_shapes(
+            self.loc.shape, self.scale.shape)))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(self.scale ** 2, jnp.broadcast_shapes(
+            self.loc.shape, self.scale.shape)))
+
+    def _bshape(self):
+        return jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        eps = jax.random.normal(key, _shape(shape) + self._bshape(),
+                                jnp.float32)
+        return _wrap(self.loc + self.scale * eps)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self.scale ** 2
+        return _wrap(-((v - self.loc) ** 2) / (2 * var)
+                     - jnp.log(self.scale)
+                     - 0.5 * jnp.log(2 * jnp.pi))
+
+    def entropy(self):
+        out = 0.5 + 0.5 * jnp.log(2 * jnp.pi) + jnp.log(self.scale)
+        return _wrap(jnp.broadcast_to(out, self._bshape()))
+
+
+class LogNormal(Normal):
+    def sample(self, shape=()):
+        return _wrap(jnp.exp(Normal.sample(self, shape)._array))
+
+    rsample = sample
+
+    @property
+    def mean(self):
+        return _wrap(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return _wrap((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(Normal.log_prob(self, jnp.log(v))._array - jnp.log(v))
+
+    def entropy(self):
+        return _wrap(Normal.entropy(self)._array + self.loc)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+
+    def _bshape(self):
+        return jnp.broadcast_shapes(self.low.shape, self.high.shape)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        u = jax.random.uniform(key, _shape(shape) + self._bshape(),
+                               jnp.float32)
+        return _wrap(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return _wrap(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                      self._bshape()))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("need logits or probs")
+        if logits is not None:
+            self.logits = _arr(logits)
+        else:
+            self.logits = jnp.log(jnp.clip(_arr(probs), 1e-38, None))
+
+    @property
+    def probs(self):
+        return _wrap(jax.nn.softmax(self.logits, -1))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return _wrap(jax.random.categorical(
+            key, self.logits, shape=_shape(shape) + self.logits.shape[:-1]))
+
+    def log_prob(self, value):
+        v = _arr(value, jnp.int32)
+        logp = jax.nn.log_softmax(self.logits, -1)
+        if logp.ndim == 1:           # unbatched logits, any value shape
+            return _wrap(logp[v])
+        return _wrap(jnp.take_along_axis(
+            logp, v[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return _wrap(-jnp.sum(jnp.exp(logp) * logp, -1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self.probs_ = _arr(probs)
+        else:
+            self.probs_ = jax.nn.sigmoid(_arr(logits))
+
+    @property
+    def mean(self):
+        return _wrap(self.probs_)
+
+    @property
+    def variance(self):
+        return _wrap(self.probs_ * (1 - self.probs_))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        u = jax.random.uniform(key, _shape(shape) + self.probs_.shape)
+        return _wrap((u < self.probs_).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return _wrap(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return _wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+
+    @property
+    def mean(self):
+        return _wrap(self.alpha / (self.alpha + self.beta))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return _wrap(jax.random.beta(
+            key, self.alpha, self.beta,
+            _shape(shape) + jnp.broadcast_shapes(self.alpha.shape,
+                                                 self.beta.shape)))
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+        v = _arr(value)
+        return _wrap((self.alpha - 1) * jnp.log(v)
+                     + (self.beta - 1) * jnp.log1p(-v)
+                     - betaln(self.alpha, self.beta))
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+        a, b = self.alpha, self.beta
+        return _wrap(betaln(a, b) - (a - 1) * digamma(a)
+                     - (b - 1) * digamma(b)
+                     + (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _arr(concentration)
+
+    @property
+    def mean(self):
+        c = self.concentration
+        return _wrap(c / jnp.sum(c, -1, keepdims=True))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return _wrap(jax.random.dirichlet(
+            key, self.concentration,
+            _shape(shape) + self.concentration.shape[:-1]))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        c = self.concentration
+        v = _arr(value)
+        return _wrap(jnp.sum((c - 1) * jnp.log(v), -1)
+                     + gammaln(jnp.sum(c, -1)) - jnp.sum(gammaln(c), -1))
+
+    def entropy(self):
+        from jax.scipy.special import gammaln, digamma
+        c = self.concentration
+        c0 = jnp.sum(c, -1)
+        k = c.shape[-1]
+        lnB = jnp.sum(gammaln(c), -1) - gammaln(c0)
+        return _wrap(lnB + (c0 - k) * digamma(c0)
+                     - jnp.sum((c - 1) * digamma(c), -1))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_ = _arr(probs)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        logits = jnp.log(jnp.clip(self.probs_, 1e-38, None))
+        draws = jax.random.categorical(
+            key, logits,
+            shape=(self.total_count,) + _shape(shape)
+            + self.probs_.shape[:-1])
+        k = self.probs_.shape[-1]
+        counts = jax.nn.one_hot(draws, k, dtype=jnp.float32).sum(0)
+        return _wrap(counts)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _arr(value)
+        p = jnp.clip(self.probs_, 1e-38, None)
+        return _wrap(gammaln(jnp.asarray(self.total_count + 1.0))
+                     - jnp.sum(gammaln(v + 1), -1)
+                     + jnp.sum(v * jnp.log(p), -1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+
+    @property
+    def mean(self):
+        return _wrap(1.0 / self.rate)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        e = jax.random.exponential(key, _shape(shape) + self.rate.shape)
+        return _wrap(e / self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return _wrap(1.0 - jnp.log(self.rate))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        sh = _shape(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                  self.scale.shape)
+        return _wrap(self.loc + self.scale * jax.random.laplace(key, sh))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(-jnp.abs(v - self.loc) / self.scale
+                     - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return _wrap(1.0 + jnp.log(2 * self.scale)
+                     + jnp.zeros_like(self.loc))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        sh = _shape(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                  self.scale.shape)
+        return _wrap(self.loc + self.scale * jax.random.gumbel(key, sh))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return _wrap(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        euler = 0.5772156649015329
+        return _wrap(jnp.log(self.scale) + 1 + euler
+                     + jnp.zeros_like(self.loc))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+
+    @property
+    def mean(self):
+        return _wrap(self.concentration / self.rate)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        sh = _shape(shape) + jnp.broadcast_shapes(
+            self.concentration.shape, self.rate.shape)
+        return _wrap(jax.random.gamma(key, self.concentration, sh)
+                     / self.rate)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _arr(value)
+        a, r = self.concentration, self.rate
+        return _wrap(a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v
+                     - gammaln(a))
+
+    def entropy(self):
+        from jax.scipy.special import gammaln, digamma
+        a, r = self.concentration, self.rate
+        return _wrap(a - jnp.log(r) + gammaln(a) + (1 - a) * digamma(a))
+
+
+# ------------------------------------------------------------------- KL
+_KL_TABLE = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Decorator registering a KL(p, q) implementation (reference:
+    paddle.distribution.register_kl)."""
+    def deco(fn):
+        _KL_TABLE[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    # exact-type lookup only: an isinstance scan would silently apply a
+    # base-class formula to a subclass with different semantics (e.g.
+    # KL(LogNormal, Normal) is NOT the Normal-Normal closed form)
+    fn = _KL_TABLE.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, "
+            f"{type(q).__name__}); use register_kl to add one")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_p, var_q = p.scale ** 2, q.scale ** 2
+    return _wrap(jnp.log(q.scale / p.scale)
+                 + (var_p + (p.loc - q.loc) ** 2) / (2 * var_q) - 0.5)
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal(p, q):
+    # KL between LogNormals equals KL between the underlying Normals
+    return _kl_normal(p, q)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    lp = jax.nn.log_softmax(p.logits, -1)
+    lq = jax.nn.log_softmax(q.logits, -1)
+    return _wrap(jnp.sum(jnp.exp(lp) * (lp - lq), -1))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return _wrap(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    from jax.scipy.special import betaln, digamma
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    return _wrap(betaln(a2, b2) - betaln(a1, b1)
+                 + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
+                 + (a2 - a1 + b2 - b1) * digamma(a1 + b1))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    return _wrap(jnp.log(p.rate / q.rate) + q.rate / p.rate - 1)

@@ -1,0 +1,132 @@
+"""Discrete Fourier transforms (reference: python/paddle/fft.py).
+
+Each transform is a registered dispatch op (tape-recorded, so gradients
+flow via jax.vjp like every other kernel); XLA lowers FFTs natively on
+TPU.  Norm conventions follow the reference: "backward" (default),
+"ortho", "forward".
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import dtypes
+from .ops import dispatch as ops
+from .tensor import Tensor
+from .tensor_api import _t
+
+__all__ = [
+    "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+    "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+    "hfft", "ihfft", "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+_COMPLEX = [
+    ("fft", jnp.fft.fft), ("ifft", jnp.fft.ifft),
+    ("fft2", jnp.fft.fft2), ("ifft2", jnp.fft.ifft2),
+    ("fftn", jnp.fft.fftn), ("ifftn", jnp.fft.ifftn),
+    ("rfft", jnp.fft.rfft), ("irfft", jnp.fft.irfft),
+    ("rfft2", jnp.fft.rfft2), ("irfft2", jnp.fft.irfft2),
+    ("rfftn", jnp.fft.rfftn), ("irfftn", jnp.fft.irfftn),
+    ("hfft", jnp.fft.hfft), ("ihfft", jnp.fft.ihfft),
+]
+
+for _name, _fn in _COMPLEX:
+    # fft math is numerically sensitive: keep out of bf16 amp casting
+    ops.register(f"fft_{_name}",
+                 (lambda f: lambda x, n=None, axis=-1, norm="backward":
+                  f(x, n=n, axis=axis, norm=norm))(_fn)
+                 if "2" not in _name and not _name.endswith("n")
+                 else (lambda f: lambda x, s=None, axes=None, norm="backward":
+                       f(x, s=s, axes=axes, norm=norm))(_fn),
+                 amp="deny")
+
+
+def _axis_call(name, x, n, axis, norm):
+    return ops.call(f"fft_{name}", _t(x), n=n, axis=axis, norm=norm)
+
+
+def _axes_call(name, x, s, axes, norm):
+    return ops.call(f"fft_{name}", _t(x), s=s, axes=axes, norm=norm)
+
+
+def fft(x, n=None, axis=-1, norm="backward"):
+    return _axis_call("fft", x, n, axis, norm)
+
+
+def ifft(x, n=None, axis=-1, norm="backward"):
+    return _axis_call("ifft", x, n, axis, norm)
+
+
+def rfft(x, n=None, axis=-1, norm="backward"):
+    return _axis_call("rfft", x, n, axis, norm)
+
+
+def irfft(x, n=None, axis=-1, norm="backward"):
+    return _axis_call("irfft", x, n, axis, norm)
+
+
+def hfft(x, n=None, axis=-1, norm="backward"):
+    return _axis_call("hfft", x, n, axis, norm)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward"):
+    return _axis_call("ihfft", x, n, axis, norm)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return _axes_call("fft2", x, s, axes, norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return _axes_call("ifft2", x, s, axes, norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return _axes_call("rfft2", x, s, axes, norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return _axes_call("irfft2", x, s, axes, norm)
+
+
+def fftn(x, s=None, axes=None, norm="backward"):
+    return _axes_call("fftn", x, s, axes, norm)
+
+
+def ifftn(x, s=None, axes=None, norm="backward"):
+    return _axes_call("ifftn", x, s, axes, norm)
+
+
+def rfftn(x, s=None, axes=None, norm="backward"):
+    return _axes_call("rfftn", x, s, axes, norm)
+
+
+def irfftn(x, s=None, axes=None, norm="backward"):
+    return _axes_call("irfftn", x, s, axes, norm)
+
+
+def fftfreq(n, d=1.0, dtype=None):
+    d_ = dtypes.convert_dtype(dtype) or jnp.float32
+    return Tensor(jnp.fft.fftfreq(n, d=d).astype(d_))
+
+
+def rfftfreq(n, d=1.0, dtype=None):
+    d_ = dtypes.convert_dtype(dtype) or jnp.float32
+    return Tensor(jnp.fft.rfftfreq(n, d=d).astype(d_))
+
+
+ops.register("fft_fftshift",
+             lambda x, axes=None: jnp.fft.fftshift(x, axes=axes),
+             amp="deny")
+ops.register("fft_ifftshift",
+             lambda x, axes=None: jnp.fft.ifftshift(x, axes=axes),
+             amp="deny")
+
+
+def fftshift(x, axes=None):
+    return ops.call("fft_fftshift", _t(x), axes=axes)
+
+
+def ifftshift(x, axes=None):
+    return ops.call("fft_ifftshift", _t(x), axes=axes)

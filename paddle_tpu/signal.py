@@ -1,0 +1,166 @@
+"""Signal processing: STFT/ISTFT (reference: python/paddle/signal.py).
+
+Each public function is a registered dispatch op (tape-recorded), so
+gradients flow to BOTH the signal and the window — paddle.signal.stft is
+differentiable and so is this one.  Framing is a gather by a static index
+matrix followed by a batched rFFT — the TPU-friendly formulation (XLA
+folds the gather; no per-frame dynamic slices).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops import dispatch as ops
+from .tensor import Tensor
+from .tensor_api import _t
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def _frame_counts(n, frame_length, hop_length):
+    if n < frame_length:
+        raise ValueError(
+            f"input length {n} is shorter than frame_length {frame_length}")
+    return 1 + (n - frame_length) // hop_length
+
+
+def _frame_impl(arr, frame_length, hop_length):
+    n = arr.shape[-1]
+    n_frames = _frame_counts(n, frame_length, hop_length)
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :])
+    return arr[..., idx]
+
+
+def _overlap_add_impl(arr, hop_length):
+    *batch, n_frames, frame_length = arr.shape
+    n = (n_frames - 1) * hop_length + frame_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :]).reshape(-1)
+    flat = arr.reshape(tuple(batch) + (n_frames * frame_length,))
+    out = jnp.zeros(tuple(batch) + (n,), arr.dtype)
+    return out.at[..., idx].add(flat)
+
+
+def _pad_window(win, win_length, n_fft):
+    if win_length < n_fft:  # center-pad the window to n_fft
+        pad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (pad, n_fft - win_length - pad))
+    return win
+
+
+def _stft_impl(arr, win, n_fft, hop_length, win_length, center, pad_mode,
+               normalized, onesided):
+    squeeze = arr.ndim == 1
+    if squeeze:
+        arr = arr[None]
+    win = _pad_window(win, win_length, n_fft)
+    if center:
+        arr = jnp.pad(arr, ((0, 0), (n_fft // 2, n_fft // 2)),
+                      mode=pad_mode)
+    frames = _frame_impl(arr, n_fft, hop_length) * win
+    spec = (jnp.fft.rfft if onesided else jnp.fft.fft)(frames, axis=-1)
+    out = spec.swapaxes(-1, -2)   # [batch, freq, time]
+    if normalized:
+        out = out / jnp.sqrt(jnp.asarray(n_fft, out.real.dtype))
+    if squeeze:
+        out = out[0]
+    return out
+
+
+def _istft_impl(spec, win, n_fft, hop_length, win_length, center,
+                normalized, onesided, length, return_complex):
+    squeeze = spec.ndim == 2
+    if squeeze:
+        spec = spec[None]
+    win = _pad_window(win, win_length, n_fft)
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    frames_spec = spec.swapaxes(-1, -2)   # [batch, time, freq]
+    if onesided:
+        frames = jnp.fft.irfft(frames_spec, n=n_fft, axis=-1)
+    else:
+        frames = jnp.fft.ifft(frames_spec, n=n_fft, axis=-1)
+        if not return_complex:
+            frames = frames.real
+    frames = frames * win
+    y = _overlap_add_impl(frames, hop_length)
+    # window envelope for COLA normalization
+    env = _overlap_add_impl(
+        jnp.broadcast_to(win * win, frames.shape[-2:]), hop_length)
+    y = y / jnp.maximum(env, 1e-11)
+    if center:
+        y = y[..., n_fft // 2:]
+        if length is None:
+            y = y[..., :y.shape[-1] - n_fft // 2]
+    if length is not None:
+        y = y[..., :length]
+    if squeeze:
+        y = y[0]
+    return y
+
+
+# numerically sensitive: keep out of bf16 amp casting
+ops.register("signal_frame", _frame_impl, amp="deny")
+ops.register("signal_overlap_add", _overlap_add_impl, amp="deny")
+ops.register("signal_stft", _stft_impl, amp="deny")
+ops.register("signal_istft", _istft_impl, amp="deny")
+
+
+def frame(x, frame_length, hop_length, axis=-1):
+    """Slice x into overlapping frames along the last axis:
+    [..., n_frames, frame_length].  Differentiable."""
+    t = _t(x)
+    if axis not in (-1, t._array.ndim - 1):
+        raise ValueError("frame: only axis=-1 is supported")
+    _frame_counts(t._array.shape[-1], frame_length, hop_length)
+    return ops.call("signal_frame", t, frame_length=frame_length,
+                    hop_length=hop_length)
+
+
+def overlap_add(x, hop_length, axis=-1):
+    """Inverse of frame(): [..., n_frames, frame_length] -> [..., n]."""
+    t = _t(x)
+    if axis not in (-1, t._array.ndim - 1):
+        raise ValueError("overlap_add: only axis=-1 is supported")
+    return ops.call("signal_overlap_add", t, hop_length=hop_length)
+
+
+def _window_tensor(window, win_length):
+    if window is None:
+        return Tensor._from_array(jnp.ones((win_length,), jnp.float32))
+    return _t(window)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True):
+    """Short-time Fourier transform.  x: [batch, n] or [n]; returns
+    [batch, n_fft//2+1 (or n_fft), n_frames] complex.  Differentiable
+    w.r.t. both x and window."""
+    t = _t(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    n = t._array.shape[-1] + (n_fft if center else 0)
+    _frame_counts(n, n_fft, hop_length)
+    return ops.call("signal_stft", t, _window_tensor(window, win_length),
+                    n_fft=n_fft, hop_length=hop_length,
+                    win_length=win_length, center=center, pad_mode=pad_mode,
+                    normalized=normalized, onesided=onesided)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False):
+    """Inverse STFT with window-envelope (COLA) normalization."""
+    if onesided and return_complex:
+        raise ValueError(
+            "onesided=True produces a real signal; return_complex=True is "
+            "contradictory (matches the reference's ValueError)")
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    return ops.call("signal_istft", _t(x), _window_tensor(window,
+                                                          win_length),
+                    n_fft=n_fft, hop_length=hop_length,
+                    win_length=win_length, center=center,
+                    normalized=normalized, onesided=onesided, length=length,
+                    return_complex=return_complex)

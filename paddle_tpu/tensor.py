@@ -365,7 +365,7 @@ for _n in ("exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
            "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh", "erf",
            "erfinv", "reciprocal", "square", "sigmoid", "isnan", "isinf",
            "isfinite", "logical_not", "bitwise_not", "conj", "digamma",
-           "lgamma", "frac", "neg"):
+           "lgamma", "frac", "neg", "real", "imag"):
     setattr(Tensor, _n, _make_unary(_n))
 
 

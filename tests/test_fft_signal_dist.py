@@ -1,0 +1,222 @@
+"""paddle.fft / paddle.signal / paddle.distribution parity tests
+(reference: python/paddle/fft.py, signal.py, distribution/*)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+# ------------------------------------------------------------------- fft
+def test_fft_roundtrip_and_numpy_parity():
+    x = np.random.RandomState(0).randn(4, 32).astype(np.float32)
+    X = pt.fft.fft(pt.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(X._array), np.fft.fft(x),
+                               rtol=1e-4, atol=1e-4)
+    back = pt.fft.ifft(X)
+    np.testing.assert_allclose(np.asarray(back._array).real, x,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_rfft_irfft_shapes():
+    x = pt.randn([2, 64])
+    X = pt.fft.rfft(x)
+    assert tuple(X.shape) == (2, 33)
+    y = pt.fft.irfft(X, n=64)
+    np.testing.assert_allclose(y.numpy(), x.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_fft2_and_norms():
+    x = np.random.RandomState(1).randn(3, 8, 8).astype(np.float32)
+    for norm in ("backward", "ortho", "forward"):
+        X = pt.fft.fft2(pt.to_tensor(x), norm=norm)
+        np.testing.assert_allclose(np.asarray(X._array),
+                                   np.fft.fft2(x, norm=norm),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_fftfreq_shift():
+    f = pt.fft.fftfreq(8, d=0.5)
+    np.testing.assert_allclose(np.asarray(f._array), np.fft.fftfreq(8, 0.5),
+                               rtol=1e-6)
+    x = pt.to_tensor(np.arange(8, dtype=np.float32))
+    np.testing.assert_allclose(np.asarray(pt.fft.fftshift(x)._array),
+                               np.fft.fftshift(np.arange(8)))
+
+
+def test_fft_gradient_flows():
+    x = pt.randn([16])
+    x.stop_gradient = False
+    y = pt.fft.rfft(x)
+    # |rfft(x)|^2 summed — real scalar of a complex intermediate
+    s = (y.real() ** 2 + y.imag() ** 2).sum()
+    s.backward()
+    assert x.grad is not None
+    assert np.isfinite(x.grad.numpy()).all()
+
+
+# ---------------------------------------------------------------- signal
+def test_frame_overlap_add_roundtrip():
+    from paddle_tpu.signal import frame, overlap_add
+    x = pt.to_tensor(np.arange(16, dtype=np.float32))
+    f = frame(x, frame_length=4, hop_length=4)  # non-overlapping
+    assert tuple(f.shape) == (4, 4)
+    y = overlap_add(f, hop_length=4)
+    np.testing.assert_allclose(y.numpy(), x.numpy())
+
+
+def test_stft_istft_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 256).astype(np.float32)
+    win = np.hanning(64).astype(np.float32)
+    spec = pt.signal.stft(pt.to_tensor(x), n_fft=64, hop_length=16,
+                          window=pt.to_tensor(win))
+    assert tuple(spec.shape) == (2, 33, 256 // 16 + 1)
+    y = pt.signal.istft(spec, n_fft=64, hop_length=16,
+                        window=pt.to_tensor(win), length=256)
+    np.testing.assert_allclose(y.numpy(), x, rtol=1e-3, atol=1e-3)
+
+
+# ----------------------------------------------------------- distribution
+def test_normal_sampling_and_stats():
+    pt.seed(0)
+    d = pt.distribution.Normal(1.0, 2.0)
+    s = d.sample([20000])
+    assert abs(float(s.mean()) - 1.0) < 0.1
+    assert abs(float(s.std()) - 2.0) < 0.1
+    lp = d.log_prob(pt.to_tensor(1.0))
+    import math
+    assert float(lp) == pytest.approx(-math.log(2 * math.sqrt(2 * math.pi)),
+                                      abs=1e-5)
+    assert float(d.entropy()) == pytest.approx(
+        0.5 + 0.5 * math.log(2 * math.pi) + math.log(2.0), abs=1e-5)
+
+
+def test_normal_kl():
+    p = pt.distribution.Normal(0.0, 1.0)
+    q = pt.distribution.Normal(1.0, 1.0)
+    assert float(pt.distribution.kl_divergence(p, q)) == pytest.approx(0.5)
+
+
+def test_categorical():
+    pt.seed(0)
+    d = pt.distribution.Categorical(probs=pt.to_tensor([0.7, 0.2, 0.1]))
+    s = d.sample([5000])
+    frac0 = float((s == 0).astype("float32").mean())
+    assert abs(frac0 - 0.7) < 0.05
+    lp = d.log_prob(pt.to_tensor(np.array([0])))
+    assert float(lp.exp()[0]) == pytest.approx(0.7, abs=1e-4)
+    # entropy of [0.7,0.2,0.1]
+    ent = -sum(p * np.log(p) for p in (0.7, 0.2, 0.1))
+    assert float(d.entropy()) == pytest.approx(ent, abs=1e-5)
+
+
+def test_uniform_bernoulli_beta():
+    pt.seed(1)
+    u = pt.distribution.Uniform(0.0, 4.0)
+    assert float(u.log_prob(pt.to_tensor(2.0))) == pytest.approx(
+        -np.log(4.0))
+    s = u.sample([1000])
+    assert 0.0 <= float(s.min()) and float(s.max()) < 4.0
+
+    b = pt.distribution.Bernoulli(probs=0.3)
+    assert float(b.mean) == pytest.approx(0.3)
+    assert float(b.log_prob(pt.to_tensor(1.0)).exp()) == pytest.approx(
+        0.3, abs=1e-5)
+
+    beta = pt.distribution.Beta(2.0, 3.0)
+    assert float(beta.mean) == pytest.approx(0.4)
+    import scipy.stats as st
+    np.testing.assert_allclose(
+        float(beta.log_prob(pt.to_tensor(0.5))),
+        st.beta(2, 3).logpdf(0.5), rtol=1e-4)
+
+
+def test_dirichlet_multinomial():
+    pt.seed(2)
+    d = pt.distribution.Dirichlet(pt.to_tensor([2.0, 3.0, 5.0]))
+    s = d.sample([100])
+    np.testing.assert_allclose(np.asarray(s._array).sum(-1), 1.0, rtol=1e-5)
+    m = pt.distribution.Multinomial(10, pt.to_tensor([0.5, 0.3, 0.2]))
+    s = m.sample([50])
+    assert np.asarray(s._array).sum(-1).max() == 10
+
+    import scipy.stats as st
+    v = np.array([0.2, 0.3, 0.5])
+    np.testing.assert_allclose(
+        float(d.log_prob(pt.to_tensor(v.astype(np.float32)))),
+        st.dirichlet([2.0, 3.0, 5.0]).logpdf(v), rtol=1e-4)
+
+
+def test_exponential_laplace_gumbel_gamma():
+    import scipy.stats as st
+    e = pt.distribution.Exponential(2.0)
+    np.testing.assert_allclose(float(e.log_prob(pt.to_tensor(1.0))),
+                               st.expon(scale=0.5).logpdf(1.0), rtol=1e-5)
+    l = pt.distribution.Laplace(0.0, 1.0)
+    np.testing.assert_allclose(float(l.log_prob(pt.to_tensor(0.5))),
+                               st.laplace.logpdf(0.5), rtol=1e-5)
+    g = pt.distribution.Gumbel(0.0, 1.0)
+    np.testing.assert_allclose(float(g.log_prob(pt.to_tensor(0.5))),
+                               st.gumbel_r.logpdf(0.5), rtol=1e-5)
+    gm = pt.distribution.Gamma(3.0, 2.0)
+    np.testing.assert_allclose(float(gm.log_prob(pt.to_tensor(1.0))),
+                               st.gamma(3.0, scale=0.5).logpdf(1.0),
+                               rtol=1e-5)
+    assert float(gm.mean) == pytest.approx(1.5)
+
+
+def test_sampling_is_seed_deterministic():
+    pt.seed(123)
+    a = pt.distribution.Normal(0.0, 1.0).sample([4]).numpy()
+    pt.seed(123)
+    b = pt.distribution.Normal(0.0, 1.0).sample([4]).numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_stft_is_differentiable_wrt_signal_and_window():
+    """Review regression: signal ops must stay on the tape (the reference's
+    stft is differentiable)."""
+    x = pt.randn([256]); x.stop_gradient = False
+    w = pt.to_tensor(np.hanning(64).astype(np.float32))
+    w.stop_gradient = False
+    spec = pt.signal.stft(x, n_fft=64, hop_length=16, window=w)
+    loss = (spec.real() ** 2 + spec.imag() ** 2).sum()
+    loss.backward()
+    assert x.grad is not None and np.abs(x.grad.numpy()).sum() > 0
+    assert w.grad is not None and np.abs(w.grad.numpy()).sum() > 0
+
+
+def test_fftshift_keeps_tape():
+    x = pt.randn([16]); x.stop_gradient = False
+    y = pt.fft.fftshift(x)
+    y.sum().backward()
+    assert x.grad is not None
+
+
+def test_frame_too_short_raises():
+    with pytest.raises(ValueError):
+        pt.signal.frame(pt.randn([10]), frame_length=64, hop_length=16)
+    with pytest.raises(ValueError):
+        pt.signal.stft(pt.randn([40]), n_fft=64, center=False)
+
+
+def test_istft_contradictory_flags_raise():
+    spec = pt.signal.stft(pt.randn([256]), n_fft=64)
+    with pytest.raises(ValueError):
+        pt.signal.istft(spec, n_fft=64, onesided=True, return_complex=True)
+
+
+def test_fftfreq_dtype_honored():
+    f = pt.fft.fftfreq(8, dtype="float16")
+    assert str(f.dtype) in ("paddle.float16", "float16")
+
+
+def test_kl_exact_type_dispatch():
+    ln = pt.distribution.LogNormal(0.0, 1.0)
+    n = pt.distribution.Normal(0.0, 1.0)
+    with pytest.raises(NotImplementedError):
+        pt.distribution.kl_divergence(ln, n)
+    # LogNormal-LogNormal == underlying Normal-Normal closed form
+    ln2 = pt.distribution.LogNormal(1.0, 1.0)
+    v = float(pt.distribution.kl_divergence(ln, ln2))
+    assert v == pytest.approx(0.5)
