@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..autograd import engine as _engine
@@ -288,9 +289,13 @@ class DistributedTrainStep:
                 gmap.get(id(p), (1.0, None))[0] for p in flat_ps]
             self._fleet_wd_overrides = [
                 gmap.get(id(p), (1.0, None))[1] for p in flat_ps]
+        if not self.use_pp:
+            self._fleet_param_names = [
+                n for n, _ in self.model.named_parameters()]
         arrays, flat_specs = self._flat_param_arrays()
         if self._opt_state is None:
             self._opt_state = self.optimizer.init_state(arrays)
+        self._merge_pending_sd()
         placed_state = []
         for slots, spec in zip(self._opt_state, flat_specs):
             placed = {}
@@ -301,6 +306,97 @@ class DistributedTrainStep:
             placed_state.append(placed)
         self._opt_state = placed_state
         self._placed = True
+
+    # ------------------------------------------------------- checkpointing
+    def _topology_tag(self):
+        return f"pp{self.pp}xvpp{self.vpp}"
+
+    def _slot_keys(self):
+        """Yield (key, slots, slot_name) over fleet-order optimizer state —
+        the single source of the checkpoint key scheme."""
+        n_outer = len(self._fleet_param_names) if not self.use_pp else \
+            len(self._pp_split()[0])
+        for i, (name, slots) in enumerate(zip(self._fleet_param_names,
+                                              self._opt_state)):
+            stacked = self.use_pp and i >= n_outer
+            for s in slots:
+                key = f"{name}/__stacked__/{s}" if stacked else \
+                    f"{name}/{s}"
+                yield key, slots, s
+
+    def state_dict(self):
+        """Optimizer-format state dict for checkpoint.save_state(optimizer=
+        step).  Non-pp entries use the exact eager-optimizer key format
+        ("<param>/<slot>"), so fleet checkpoints resume into eager runs and
+        vice versa; pp-stacked leaves are saved under
+        "<block0 param>/__stacked__/<slot>" (topology-bound: resume needs
+        the same pp x virtual_pp split, recorded in __fleet_topology__)."""
+        out = {"step": self._step}
+        from ..optimizer.lr import LRScheduler
+        if isinstance(self.optimizer._lr, LRScheduler):
+            out["LR_Scheduler"] = self.optimizer._lr.state_dict()
+        if self.use_pp:
+            out["__fleet_topology__"] = self._topology_tag()
+        if self._opt_state is None:
+            # not placed yet: pass through any still-pending loaded state
+            # so save-after-load-before-step doesn't drop the moments
+            for k, v in (getattr(self, "_pending_sd", None) or {}).items():
+                out[k] = Tensor._from_array(v)
+            return out
+        for key, slots, s in self._slot_keys():
+            out[key] = Tensor._from_array(slots[s])
+        return out
+
+    def set_state_dict(self, state):
+        """Inverse of state_dict(); may be called before or after the first
+        step (pending state is merged when the engine places its arrays)."""
+        self._step = int(state.get("step", 0))
+        self.optimizer._step_count = self._step
+        from ..optimizer.lr import LRScheduler
+        if "LR_Scheduler" in state and isinstance(self.optimizer._lr,
+                                                  LRScheduler):
+            self.optimizer._lr.set_state_dict(state["LR_Scheduler"])
+        pending = {
+            k: (v._array if isinstance(v, Tensor) else jnp.asarray(v))
+            for k, v in state.items()
+            if k not in ("step", "LR_Scheduler", "__fleet_topology__")}
+        tag = state.get("__fleet_topology__")
+        if tag is not None:
+            tag = str(np.asarray(tag)) if not isinstance(tag, str) else tag
+        has_stacked = any("/__stacked__/" in k for k in pending)
+        if self.use_pp:
+            if tag is not None and tag != self._topology_tag():
+                raise ValueError(
+                    f"fleet checkpoint topology {tag} does not match this "
+                    f"engine ({self._topology_tag()}); stacked optimizer "
+                    "rows would be assigned to the wrong layers")
+            if pending and not has_stacked:
+                raise ValueError(
+                    "checkpoint has no __stacked__ optimizer entries — it "
+                    "was saved by a non-pp run and cannot seed a pp engine")
+        elif has_stacked:
+            raise ValueError(
+                "checkpoint contains pp-stacked optimizer entries; this "
+                "engine runs pp=1 — resume with the saving topology "
+                f"({tag or 'unknown'})")
+        self._pending_sd = pending
+        if self._placed:
+            self._merge_pending_sd()
+            # restack from the (just-restored) eager block weights and
+            # re-place everything with shardings on the next call — the
+            # old stacked copy is stale the moment weights were loaded
+            self._stacked = None
+            self._model_stale = False
+            self._placed = False
+
+    def _merge_pending_sd(self):
+        sd = getattr(self, "_pending_sd", None)
+        if not sd or self._opt_state is None:
+            return
+        for key, slots, s in self._slot_keys():
+            if key in sd:
+                slots[s] = sd[key]
+        self._pending_sd = None
 
     # ------------------------------------------------------- multi-process
     def _globalize_batch(self, batch_arrays):
