@@ -350,12 +350,8 @@ class DistributedTrainStep:
     def set_state_dict(self, state):
         """Inverse of state_dict(); may be called before or after the first
         step (pending state is merged when the engine places its arrays)."""
-        self._step = int(state.get("step", 0))
-        self.optimizer._step_count = self._step
-        from ..optimizer.lr import LRScheduler
-        if "LR_Scheduler" in state and isinstance(self.optimizer._lr,
-                                                  LRScheduler):
-            self.optimizer._lr.set_state_dict(state["LR_Scheduler"])
+        # validate BEFORE mutating anything, so a rejected checkpoint
+        # leaves the engine untouched
         pending = {
             k: (v._array if isinstance(v, Tensor) else jnp.asarray(v))
             for k, v in state.items()
@@ -379,14 +375,21 @@ class DistributedTrainStep:
                 "checkpoint contains pp-stacked optimizer entries; this "
                 "engine runs pp=1 — resume with the saving topology "
                 f"({tag or 'unknown'})")
+        self._step = int(state.get("step", 0))
+        self.optimizer._step_count = self._step
+        from ..optimizer.lr import LRScheduler
+        if "LR_Scheduler" in state and isinstance(self.optimizer._lr,
+                                                  LRScheduler):
+            self.optimizer._lr.set_state_dict(state["LR_Scheduler"])
         self._pending_sd = pending
         if self._placed:
             self._merge_pending_sd()
-            # restack from the (just-restored) eager block weights and
-            # re-place everything with shardings on the next call — the
-            # old stacked copy is stale the moment weights were loaded
+            # flush trained block weights to the eager model first (a
+            # weights-only or moments-only load must not lose them), then
+            # drop the stacked copy so the next call restacks from the
+            # now-current eager params and re-places with shardings
+            self.sync_model()
             self._stacked = None
-            self._model_stale = False
             self._placed = False
 
     def _merge_pending_sd(self):
@@ -510,11 +513,12 @@ class DistributedTrainStep:
             fleet_scales = self._fleet_lr_scales
             fleet_wds = self._fleet_wd_overrides
         else:
-            named = list(model.named_parameters())
-            fleet_names = [n for n, _ in named]
-            fleet_scales = [gmap.get(id(p), (1.0, None))[0] for _, p in named]
-            fleet_wds = [gmap.get(id(p), (1.0, None))[1] for _, p in named]
-            self._fleet_param_names = fleet_names
+            # key ordering was fixed in _place_state (single source for
+            # the checkpoint key scheme) — only derive the group scales
+            fleet_names = self._fleet_param_names
+            params_ = [p for _, p in model.named_parameters()]
+            fleet_scales = [gmap.get(id(p), (1.0, None))[0] for p in params_]
+            fleet_wds = [gmap.get(id(p), (1.0, None))[1] for p in params_]
 
         def step_fn(param_tree, buffer_arrays, opt_state, lr, step, rng,
                     batch):

@@ -211,6 +211,12 @@ class Optimizer:
         return out
 
     def set_state_dict(self, state):
+        if any("/__stacked__/" in k for k in state):
+            raise ValueError(
+                "checkpoint contains pipeline-stacked optimizer entries "
+                "(saved via a fleet pp engine); load it with "
+                "load_state(optimizer=<fleet train step>) on the same "
+                "pp topology instead of an eager optimizer")
         self._step_count = int(state.get("step", 0))
         if self._state is None:
             self._state = self.init_state(

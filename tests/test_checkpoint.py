@@ -174,25 +174,27 @@ def test_fleet_engine_resume_matches_uninterrupted(tmp_path):
                                 parameters=m.parameters())
         return m, opt, fleet.build_train_step(m, gpt_loss_fn, opt)
 
-    pt.seed(3)
-    ids = pt.randint(0, 64, [4, 16])
-    labels = pt.randint(0, 64, [4, 16])
+    try:
+        pt.seed(3)
+        ids = pt.randint(0, 64, [4, 16])
+        labels = pt.randint(0, 64, [4, 16])
 
-    # uninterrupted 4-step run
-    m1, _, step1 = build()
-    ref_losses = [float(step1(ids, labels)) for _ in range(4)]
+        # uninterrupted 4-step run
+        m1, _, step1 = build()
+        ref_losses = [float(step1(ids, labels)) for _ in range(4)]
 
-    # interrupted: 2 steps -> save -> rebuild -> load -> 2 more steps
-    m2, _, step2 = build()
-    for _ in range(2):
-        step2(ids, labels)
-    pt.save_state(str(tmp_path / "fleet_ck"), model=m2, optimizer=step2)
+        # interrupted: 2 steps -> save -> rebuild -> load -> 2 more steps
+        m2, _, step2 = build()
+        for _ in range(2):
+            step2(ids, labels)
+        pt.save_state(str(tmp_path / "fleet_ck"), model=m2, optimizer=step2)
 
-    m3, _, step3 = build()
-    pt.load_state(str(tmp_path / "fleet_ck"), model=m3, optimizer=step3)
-    resumed = [float(step3(ids, labels)) for _ in range(2)]
-    np.testing.assert_allclose(resumed, ref_losses[2:], rtol=1e-5)
-    mesh_mod._state.update(prev)
+        m3, _, step3 = build()
+        pt.load_state(str(tmp_path / "fleet_ck"), model=m3, optimizer=step3)
+        resumed = [float(step3(ids, labels)) for _ in range(2)]
+        np.testing.assert_allclose(resumed, ref_losses[2:], rtol=1e-5)
+    finally:
+        mesh_mod._state.update(prev)
 
 
 def test_fleet_resume_topology_guards(tmp_path):
@@ -218,44 +220,53 @@ def test_fleet_resume_topology_guards(tmp_path):
                                 parameters=m.parameters())
         return m, fleet.build_train_step(m, gpt_loss_fn, opt)
 
-    pt.seed(3)
-    ids = pt.randint(0, 64, [4, 16])
-    labels = pt.randint(0, 64, [4, 16])
-    m1, s1 = build(vpp=2)
-    s1(ids, labels)
-    pt.save_state(str(tmp_path / "vpp2"), model=m1, optimizer=s1)
+    try:
+        pt.seed(3)
+        ids = pt.randint(0, 64, [4, 16])
+        labels = pt.randint(0, 64, [4, 16])
+        m1, s1 = build(vpp=2)
+        s1(ids, labels)
+        pt.save_state(str(tmp_path / "vpp2"), model=m1, optimizer=s1)
 
-    # vpp mismatch -> loud error (stacked rows would be layer-permuted)
-    m2, s2 = build(vpp=1)
-    with pytest.raises(ValueError, match="topology"):
-        pt.load_state(str(tmp_path / "vpp2"), model=m2, optimizer=s2)
+        # vpp mismatch -> loud error (stacked rows would be layer-permuted)
+        m2, s2 = build(vpp=1)
+        with pytest.raises(ValueError, match="topology"):
+            pt.load_state(str(tmp_path / "vpp2"), model=m2, optimizer=s2)
 
-    # eager-format checkpoint into a pp engine -> loud error
-    pt.seed(7)
-    from paddle_tpu.text import GPTConfig as _C
-    cfg = _C(vocab_size=64, hidden_size=32, num_layers=4, num_heads=4,
-             max_position_embeddings=32, hidden_dropout=0.0,
-             attention_dropout=0.0, tensor_parallel=False)
-    me = GPTForCausalLM(cfg)
-    oe = pt.optimizer.Adam(learning_rate=0.02, parameters=me.parameters())
-    gpt_loss_fn(me, ids, labels).backward()
-    oe.step(); oe.clear_grad()
-    pt.save_state(str(tmp_path / "eager"), model=me, optimizer=oe)
-    m3, s3 = build(vpp=2)
-    with pytest.raises(ValueError, match="non-pp"):
-        pt.load_state(str(tmp_path / "eager"), model=m3, optimizer=s3)
+        # eager-format checkpoint into a pp engine -> loud error
+        pt.seed(7)
+        from paddle_tpu.text import GPTConfig as _C
+        cfg = _C(vocab_size=64, hidden_size=32, num_layers=4, num_heads=4,
+                 max_position_embeddings=32, hidden_dropout=0.0,
+                 attention_dropout=0.0, tensor_parallel=False)
+        me = GPTForCausalLM(cfg)
+        oe = pt.optimizer.Adam(learning_rate=0.02, parameters=me.parameters())
+        gpt_loss_fn(me, ids, labels).backward()
+        oe.step(); oe.clear_grad()
+        pt.save_state(str(tmp_path / "eager"), model=me, optimizer=oe)
+        m3, s3 = build(vpp=2)
+        with pytest.raises(ValueError, match="non-pp"):
+            pt.load_state(str(tmp_path / "eager"), model=m3, optimizer=s3)
 
-    # save-after-load-before-step keeps the loaded moments
-    m4, s4 = build(vpp=2)
-    pt.load_state(str(tmp_path / "vpp2"), model=m4, optimizer=s4)
-    sd = s4.state_dict()
-    assert any("__stacked__" in k for k in sd)
-    pt.save_state(str(tmp_path / "resaved"), model=m4, optimizer=s4)
-    m5, s5 = build(vpp=2)
-    pt.load_state(str(tmp_path / "resaved"), model=m5, optimizer=s5)
-    l5 = float(s5(ids, labels))
-    m6, s6 = build(vpp=2)
-    pt.load_state(str(tmp_path / "vpp2"), model=m6, optimizer=s6)
-    l6 = float(s6(ids, labels))
-    np.testing.assert_allclose(l5, l6, rtol=1e-6)
-    mesh_mod._state.update(prev)
+        # save-after-load-before-step keeps the loaded moments
+        m4, s4 = build(vpp=2)
+        pt.load_state(str(tmp_path / "vpp2"), model=m4, optimizer=s4)
+        sd = s4.state_dict()
+        assert any("__stacked__" in k for k in sd)
+        pt.save_state(str(tmp_path / "resaved"), model=m4, optimizer=s4)
+        m5, s5 = build(vpp=2)
+        pt.load_state(str(tmp_path / "resaved"), model=m5, optimizer=s5)
+        l5 = float(s5(ids, labels))
+        m6, s6 = build(vpp=2)
+        pt.load_state(str(tmp_path / "vpp2"), model=m6, optimizer=s6)
+        l6 = float(s6(ids, labels))
+        np.testing.assert_allclose(l5, l6, rtol=1e-6)
+    finally:
+        mesh_mod._state.update(prev)
+
+
+def test_eager_optimizer_rejects_stacked_checkpoint():
+    m = pt.nn.Linear(4, 4)
+    opt = pt.optimizer.Adam(learning_rate=0.1, parameters=m.parameters())
+    with pytest.raises(ValueError, match="fleet"):
+        opt.set_state_dict({"weight/__stacked__/moment1": pt.zeros([2, 4])})

@@ -1,0 +1,112 @@
+"""Eager (dygraph) dispatch overhead microbench — VERDICT weak #8.
+
+Measures ops/sec for small eager chains through the full Tensor dispatch
+(amp policy + vjp tape) vs raw jnp, and the same workload under the fused
+train step, quantifying the per-op eager tax and what jit recovers.
+
+Run on CPU (default here) or TPU (unset FORCE_CPU).
+"""
+import os
+import sys
+import time
+
+if os.environ.get("FORCE_CPU", "1") == "1":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import paddle_tpu as pt  # noqa: E402
+
+
+def time_loop(fn, iters=200, warmup=20):
+    for _ in range(warmup):
+        out = fn()
+    np.asarray(out._array if hasattr(out, "_array") else out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    np.asarray(out._array if hasattr(out, "_array") else out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    n = int(os.environ.get("N", "256"))
+    x_t = pt.randn([n, n])
+    w_t = pt.randn([n, n])
+    x_j, w_j = x_t._array, w_t._array
+
+    # --- chain: 5 ops (matmul + bias-ish + activations)
+    def eager_nograd():
+        with pt.no_grad():
+            import paddle_tpu.nn.functional as F
+            return F.relu((x_t @ w_t).tanh() + x_t).sum()
+
+    def eager_grad():
+        w = w_t.detach()
+        w.stop_gradient = False
+        import paddle_tpu.nn.functional as F
+        loss = F.relu((x_t @ w).tanh() + x_t).sum()
+        loss.backward()
+        return loss
+
+    def raw_jnp():
+        return jax.nn.relu(jnp.tanh(x_j @ w_j) + x_j).sum()
+
+    jitted = jax.jit(lambda x, w: jax.nn.relu(
+        jnp.tanh(x @ w) + x).sum())
+
+    def jit_chain():
+        return jitted(x_j, w_j)
+
+    t_e0 = time_loop(eager_nograd)
+    t_e1 = time_loop(eager_grad, iters=50)
+    t_r = time_loop(raw_jnp)
+    t_j = time_loop(jit_chain)
+    ops = 5
+    print(f"chain[{n}x{n}], 5 ops:")
+    print(f"  raw jnp (eager jax)   {t_r*1e6:9.1f} us  "
+          f"({ops/t_r:,.0f} ops/s)")
+    print(f"  pt eager no_grad      {t_e0*1e6:9.1f} us  "
+          f"({ops/t_e0:,.0f} ops/s, {t_e0/t_r:.2f}x raw)")
+    print(f"  pt eager +backward    {t_e1*1e6:9.1f} us  "
+          f"({t_e1/t_r:.2f}x raw)")
+    print(f"  jax.jit whole chain   {t_j*1e6:9.1f} us  "
+          f"({t_r/t_j:.2f}x faster than raw)")
+
+    # --- the recovery story: fused train step vs eager training step
+    pt.seed(0)
+    m = pt.nn.Sequential(pt.nn.Linear(n, n), pt.nn.Tanh(),
+                         pt.nn.Linear(n, n))
+    opt = pt.optimizer.SGD(learning_rate=1e-3, parameters=m.parameters())
+    y = pt.randn([32, n])
+    xb = pt.randn([32, n])
+
+    import paddle_tpu.nn.functional as F
+
+    def eager_train():
+        loss = F.mse_loss(m(xb), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = pt.jit.train_step(m, lambda mm, a, b: F.mse_loss(mm(a), b), opt)
+
+    def fused_train():
+        return step(xb, y)
+
+    t_et = time_loop(eager_train, iters=30)
+    t_ft = time_loop(fused_train, iters=100)
+    print(f"train step (MLP {n}):")
+    print(f"  eager (per-op tape)   {t_et*1e3:9.2f} ms")
+    print(f"  fused jit train_step  {t_ft*1e3:9.2f} ms  "
+          f"({t_et/t_ft:.1f}x faster)")
+
+
+if __name__ == "__main__":
+    main()
