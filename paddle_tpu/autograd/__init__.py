@@ -2,4 +2,6 @@
 from .engine import no_grad, enable_grad, set_grad_enabled, grad_enabled  # noqa: F401
 from .engine import run_backward  # noqa: F401
 from .functional import grad, backward  # noqa: F401
+from .functional import jacobian, hessian, jvp, vjp  # noqa: F401
+from . import functional  # noqa: F401
 from .py_layer import PyLayer, PyLayerContext  # noqa: F401

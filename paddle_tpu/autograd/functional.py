@@ -62,3 +62,100 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         else:
             out.append(g)
     return out
+
+
+# ---------------------------------------------------------------- jax-native
+# higher-order functional transforms (reference: paddle.autograd.jacobian /
+# hessian and paddle.incubate.autograd.{jvp,vjp}) — thin shims over jax's
+# transforms, operating on Tensor-valued functions.
+
+def _functionalize(func):
+    """Wrap a Tensor-function as a pure array-function for jax transforms."""
+    from ..tensor import Tensor
+
+    def fn(*arrays):
+        with engine.no_grad():
+            wrapped = [Tensor._from_array(a) for a in arrays]
+            out = func(*wrapped)
+        if isinstance(out, (list, tuple)):
+            return type(out)(o._array if isinstance(o, Tensor) else o
+                             for o in out)
+        return out._array if isinstance(out, Tensor) else out
+    return fn
+
+
+def _tensorize(out):
+    from ..tensor import Tensor
+    import jax
+    return jax.tree_util.tree_map(Tensor._from_array, out)
+
+
+def _arrays(xs):
+    from ..tensor import Tensor
+    xs = _as_list(xs)
+    return [x._array if isinstance(x, Tensor) else jnp.asarray(x)
+            for x in xs]
+
+
+def _check_unsupported(create_graph, batch_axis):
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True is not supported: these transforms return "
+            "detached results (compose jax transforms for higher order)")
+    if batch_axis is not None:
+        raise NotImplementedError(
+            "batch_axis is not supported yet; vmap the function instead")
+
+
+def jacobian(func, xs, create_graph=False, batch_axis=None):
+    """d func(xs) / d xs (reverse mode).  Returns a Tensor (single input &
+    output) or a nested tuple matching (outputs, inputs)."""
+    import jax
+    _check_unsupported(create_graph, batch_axis)
+    arrays = _arrays(xs)
+    single_in = not isinstance(xs, (list, tuple))
+    # int argnums for the single-input case: jax then omits the inner
+    # per-argument tuple, so multi-output functions keep every jacobian
+    argnums = 0 if single_in else tuple(range(len(arrays)))
+    jac = jax.jacrev(_functionalize(func), argnums=argnums)(*arrays)
+    return _tensorize(jac)
+
+
+def hessian(func, xs, create_graph=False, batch_axis=None):
+    """d^2 func(xs) / d xs^2 for scalar-output func."""
+    import jax
+    _check_unsupported(create_graph, batch_axis)
+    arrays = _arrays(xs)
+    single_in = not isinstance(xs, (list, tuple))
+    argnums = 0 if single_in else tuple(range(len(arrays)))
+    h = jax.hessian(_functionalize(func), argnums=argnums)(*arrays)
+    return _tensorize(h)
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode: (func(xs), J @ v).  v defaults to ones."""
+    import jax
+    arrays = _arrays(xs)
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrays]
+    else:
+        tangents = _arrays(v)
+    out, tan = jax.jvp(_functionalize(func), tuple(arrays), tuple(tangents))
+    return _tensorize(out), _tensorize(tan)
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode: (func(xs), v^T @ J).  v defaults to ones."""
+    import jax
+    arrays = _arrays(xs)
+    out, pullback = jax.vjp(_functionalize(func), *arrays)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        vs = _arrays(v)
+        cot = vs[0] if not isinstance(out, (list, tuple)) else type(out)(vs)
+    grads = pullback(cot)
+    single_in = not isinstance(xs, (list, tuple))
+    if single_in:
+        grads = grads[0]
+    return _tensorize(out), _tensorize(grads)

@@ -1,0 +1,211 @@
+"""Auto-parallel API (reference: python/paddle/distributed/auto_parallel —
+ProcessMesh, shard_tensor, reshard, Shard/Replicate/Partial placements, the
+paddle-3.0 unified distributed surface).
+
+TPU-native: a ProcessMesh IS a jax.sharding.Mesh; placements translate to
+a PartitionSpec and shard_tensor is one device_put with a NamedSharding —
+GSPMD then propagates layouts and inserts collectives, which is exactly
+the reference's "auto" semantics (its planner searches placements; XLA's
+propagation solves the same problem from the annotations).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..tensor import Tensor
+from . import mesh as mesh_mod
+
+__all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+           "shard_tensor", "reshard", "dtensor_from_fn", "get_placements"]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    """Tensor dim `dim` is split along the corresponding mesh dim."""
+
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    """Pending-reduction placement.  XLA tracks partial sums internally
+    during propagation; as an input annotation it is equivalent to
+    Replicate (the reference also materializes Partial only between ops)."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Partial)
+                and other.reduce_type == self.reduce_type)
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+
+class ProcessMesh:
+    """N-d mesh of devices with named dims (reference: dist.ProcessMesh).
+
+    mesh = ProcessMesh([[0, 1], [2, 3]], dim_names=["dp", "mp"])
+    """
+
+    def __init__(self, mesh, dim_names=None, process_ids=None):
+        arr = np.asarray(mesh if mesh is not None else process_ids)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(
+                f"{arr.ndim}-d mesh needs {arr.ndim} dim_names, got "
+                f"{list(dim_names)}")
+        devices = jax.devices()
+        if arr.min() < 0 or arr.max() >= len(devices):
+            raise ValueError(
+                f"process ids must be in [0, {len(devices)}); got range "
+                f"[{int(arr.min())}, {int(arr.max())}]")
+        devs = np.vectorize(lambda i: devices[i])(arr)
+        self._jax_mesh = Mesh(devs, tuple(dim_names))
+        self.shape = list(arr.shape)
+        self.dim_names = list(dim_names)
+        self.process_ids = arr.reshape(-1).tolist()
+
+    @property
+    def mesh(self):
+        return self._jax_mesh
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"dim_names={self.dim_names})")
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self.shape == other.shape
+                and self.dim_names == other.dim_names
+                and self.process_ids == other.process_ids)
+
+    def __hash__(self):
+        return hash((tuple(self.shape), tuple(self.dim_names),
+                     tuple(self.process_ids)))
+
+
+def _to_jax_mesh(mesh):
+    if isinstance(mesh, ProcessMesh):
+        return mesh._jax_mesh
+    if isinstance(mesh, Mesh):
+        return mesh
+    if mesh is None:
+        return mesh_mod.get_mesh()
+    raise TypeError(f"expected ProcessMesh, got {type(mesh).__name__}")
+
+
+def _placements_to_pspec(placements, mesh, ndim):
+    """placements[i] describes mesh dim i (reference semantics); convert to
+    a per-tensor-dim PartitionSpec."""
+    names = mesh.axis_names
+    if len(placements) > len(names):
+        raise ValueError(
+            f"{len(placements)} placements for a {len(names)}-d mesh")
+    spec = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            d = pl.dim if pl.dim >= 0 else pl.dim + ndim
+            if not 0 <= d < ndim:
+                raise ValueError(f"Shard(dim={pl.dim}) out of range for "
+                                 f"{ndim}-d tensor")
+            if spec[d] is None:
+                spec[d] = names[mesh_dim]
+            elif isinstance(spec[d], tuple):
+                spec[d] = spec[d] + (names[mesh_dim],)
+            else:
+                spec[d] = (spec[d], names[mesh_dim])
+        # Replicate / Partial -> no annotation on that mesh dim
+    return P(*spec)
+
+
+def shard_tensor(data, mesh, placements, dtype=None, stop_gradient=None):
+    """Place `data` on the mesh with the given placements; returns a Tensor
+    whose underlying jax.Array is GSPMD-sharded (its .pspec records the
+    annotation so distributed layers/engines compose)."""
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    jm = _to_jax_mesh(mesh)
+    spec = _placements_to_pspec(list(placements), jm, t._array.ndim)
+    arr = jax.device_put(t._array, NamedSharding(jm, spec))
+    out = Tensor._from_array(arr)
+    out.stop_gradient = t.stop_gradient if stop_gradient is None \
+        else stop_gradient
+    out.pspec = tuple(spec)
+    return out
+
+
+def reshard(tensor, mesh, placements):
+    """Change a tensor's distribution (reference: dist.reshard) — one
+    device_put; XLA emits the collective (all-gather / all-to-all /
+    slice) implied by the layout change."""
+    return shard_tensor(tensor, mesh, placements)
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    """Build a sharded tensor from a creation fn (reference:
+    dist.dtensor_from_fn), e.g. dtensor_from_fn(paddle.ones, mesh,
+    [Shard(0)], [1024, 1024])."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def get_placements(tensor):
+    """Recover per-mesh-dim placements from a sharded Tensor."""
+    arr = tensor._array if isinstance(tensor, Tensor) else tensor
+    sh = getattr(arr, "sharding", None)
+    if sh is None or not isinstance(sh, NamedSharding):
+        return None
+    names = sh.mesh.axis_names
+    spec = list(sh.spec) + [None] * (arr.ndim - len(sh.spec))
+    out = [Replicate() for _ in names]
+    for tdim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        for name in (entry if isinstance(entry, tuple) else (entry,)):
+            out[names.index(name)] = Shard(tdim)
+    return out

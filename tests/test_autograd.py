@@ -159,3 +159,38 @@ def test_is_grad_enabled():
     assert pt.is_grad_enabled()
     with pt.no_grad():
         assert not pt.is_grad_enabled()
+
+
+def test_functional_jacobian_hessian():
+    """paddle.autograd.jacobian/hessian (jax-native transforms)."""
+    from paddle_tpu.autograd import jacobian, hessian
+    x = pt.to_tensor(np.array([1.0, 2.0], np.float32))
+
+    def f(x):
+        return (x ** 2).sum()
+
+    h = hessian(f, x)
+    np.testing.assert_allclose(h.numpy(), 2 * np.eye(2), rtol=1e-5)
+
+    def g(x):
+        return x ** 3
+
+    j = jacobian(g, x)
+    np.testing.assert_allclose(j.numpy(), np.diag(3 * np.array([1.0, 4.0])),
+                               rtol=1e-5)
+
+
+def test_functional_jvp_vjp():
+    from paddle_tpu.autograd import jvp, vjp
+    x = pt.to_tensor(np.array([1.0, 2.0], np.float32))
+    v = pt.to_tensor(np.array([1.0, 0.0], np.float32))
+
+    def f(x):
+        return x ** 2
+
+    out, tan = jvp(f, x, v)
+    np.testing.assert_allclose(np.asarray(tan._array), [2.0, 0.0],
+                               rtol=1e-5)
+    out, grads = vjp(f, x, v)
+    np.testing.assert_allclose(np.asarray(grads._array), [2.0, 0.0],
+                               rtol=1e-5)
