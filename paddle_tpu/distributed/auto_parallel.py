@@ -165,17 +165,26 @@ def _placements_to_pspec(placements, mesh, ndim):
     return P(*spec)
 
 
+from ..ops import dispatch as _ops
+
+# tape-recorded relayout: device_put is differentiable (its transpose is a
+# device_put back), so resharding composes with backward()
+_ops.register("reshard",
+              lambda x, sharding=None: jax.device_put(x, sharding),
+              amp="keep")
+
+
 def shard_tensor(data, mesh, placements, dtype=None, stop_gradient=None):
     """Place `data` on the mesh with the given placements; returns a Tensor
     whose underlying jax.Array is GSPMD-sharded (its .pspec records the
-    annotation so distributed layers/engines compose)."""
+    annotation so distributed layers/engines compose).  Tape-recorded:
+    gradients flow through a reshard."""
     t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
     jm = _to_jax_mesh(mesh)
     spec = _placements_to_pspec(list(placements), jm, t._array.ndim)
-    arr = jax.device_put(t._array, NamedSharding(jm, spec))
-    out = Tensor._from_array(arr)
-    out.stop_gradient = t.stop_gradient if stop_gradient is None \
-        else stop_gradient
+    out = _ops.call("reshard", t, sharding=NamedSharding(jm, spec))
+    if stop_gradient is not None:
+        out.stop_gradient = stop_gradient
     out.pspec = tuple(spec)
     return out
 

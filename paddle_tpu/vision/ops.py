@@ -1,0 +1,260 @@
+"""Detection ops (reference: python/paddle/vision/ops.py — nms, roi_align,
+roi_pool, box_coder).
+
+TPU-native formulations: NMS is a greedy scan over a precomputed O(N^2)
+IoU matrix (static shapes, no data-dependent loops — XLA-friendly, unlike
+the reference's CUDA kernel with dynamic output count: we return indices
+padded/validity-masked then slice on host).  RoIAlign is fully vectorized
+bilinear gather over sampling points.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor import Tensor
+from ..tensor_api import _t
+from ..ops import dispatch as ops
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "box_iou"]
+
+
+# ------------------------------------------------------------------ box iou
+def _iou_matrix(boxes_a, boxes_b):
+    """[N, 4] x [M, 4] (x1, y1, x2, y2) -> [N, M] IoU."""
+    area_a = ((boxes_a[:, 2] - boxes_a[:, 0])
+              * (boxes_a[:, 3] - boxes_a[:, 1]))
+    area_b = ((boxes_b[:, 2] - boxes_b[:, 0])
+              * (boxes_b[:, 3] - boxes_b[:, 1]))
+    lt = jnp.maximum(boxes_a[:, None, :2], boxes_b[None, :, :2])
+    rb = jnp.minimum(boxes_a[:, None, 2:], boxes_b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+ops.register("box_iou", _iou_matrix, amp="deny")
+
+
+def box_iou(boxes_a, boxes_b):
+    return ops.call("box_iou", _t(boxes_a), _t(boxes_b))
+
+
+# ---------------------------------------------------------------------- nms
+def _nms_impl(boxes, scores, iou_threshold):
+    """Greedy NMS: returns (keep_mask [N] bool) in score order semantics."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    boxes_sorted = boxes[order]
+    iou = _iou_matrix(boxes_sorted, boxes_sorted)
+
+    def body(keep, i):
+        # i suppressed if a higher-scoring kept box overlaps it
+        sup = jnp.any((jnp.arange(n) < i) & keep
+                      & (iou[:, i] > iou_threshold))
+        keep = keep.at[i].set(~sup)
+        return keep, None
+
+    keep0 = jnp.zeros((n,), bool).at[0].set(True) if n else \
+        jnp.zeros((n,), bool)
+    keep, _ = lax.scan(body, keep0, jnp.arange(1, n)) if n > 1 else \
+        (keep0, None)
+    # map back to original indices
+    mask = jnp.zeros((n,), bool).at[order].set(keep)
+    return mask, order
+
+
+def nms(boxes, scores=None, iou_threshold=0.3, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy non-maximum suppression.  Returns kept indices (Tensor,
+    descending score).  With category_idxs/categories, NMS is per-class
+    (boxes of different classes never suppress each other)."""
+    b = _t(boxes)._array
+    n = b.shape[0]
+    s = (_t(scores)._array if scores is not None
+         else jnp.arange(n, 0, -1, dtype=jnp.float32))
+    if category_idxs is not None and categories is not None:
+        # offset trick: shift each class's boxes to a disjoint region so
+        # cross-class IoU is zero (one fused NMS instead of per-class loops)
+        cidx = _t(category_idxs)._array.astype(jnp.float32)
+        span = jnp.maximum(b.max() - b.min(), 1.0) + 1.0
+        b = b + (cidx * span)[:, None]
+    mask, order = _nms_impl(b, s, float(iou_threshold))
+    import numpy as np
+    mask_np = np.asarray(mask)
+    order_np = np.asarray(order)
+    kept_sorted = order_np[mask_np[order_np]]
+    if top_k is not None:
+        kept_sorted = kept_sorted[:int(top_k)]
+    return Tensor._from_array(jnp.asarray(kept_sorted, jnp.int32))
+
+
+# ---------------------------------------------------------------- roi align
+def _roi_align_impl(x, boxes, boxes_num, output_size, spatial_scale,
+                    sampling_ratio, aligned):
+    """x: [N, C, H, W]; boxes: [R, 4] (x1, y1, x2, y2); boxes_num: [N]."""
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    ph, pw = output_size
+    # roi -> batch index
+    batch_idx = jnp.repeat(jnp.arange(N), boxes_num, axis=0,
+                           total_repeat_length=R)
+    offset = 0.5 if aligned else 0.0
+    bx = boxes * spatial_scale - offset
+    x1, y1, x2, y2 = bx[:, 0], bx[:, 1], bx[:, 2], bx[:, 3]
+    rw = x2 - x1
+    rh = y2 - y1
+    if not aligned:
+        rw = jnp.maximum(rw, 1.0)
+        rh = jnp.maximum(rh, 1.0)
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+    # sampling grid: [R, ph*sr] x [R, pw*sr]
+    ys = (y1[:, None] + (jnp.arange(ph * sr) + 0.5)[None, :]
+          * (rh / (ph * sr))[:, None])
+    xs = (x1[:, None] + (jnp.arange(pw * sr) + 0.5)[None, :]
+          * (rw / (pw * sr))[:, None])
+
+    def bilinear(img, yy, xx):
+        """img [C, H, W]; yy [hs], xx [ws] -> [C, hs, ws]."""
+        yy = jnp.clip(yy, 0.0, H - 1.0)
+        xx = jnp.clip(xx, 0.0, W - 1.0)
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        y1_ = jnp.minimum(y0 + 1, H - 1)
+        x1_ = jnp.minimum(x0 + 1, W - 1)
+        wy = yy - y0
+        wx = xx - x0
+        g = lambda yi, xi: img[:, yi, :][:, :, xi]  # noqa: E731
+        v = (g(y0, x0) * ((1 - wy)[:, None] * (1 - wx)[None, :])[None]
+             + g(y0, x1_) * ((1 - wy)[:, None] * wx[None, :])[None]
+             + g(y1_, x0) * (wy[:, None] * (1 - wx)[None, :])[None]
+             + g(y1_, x1_) * (wy[:, None] * wx[None, :])[None])
+        return v
+
+    import jax
+    sampled = jax.vmap(
+        lambda bi, yy, xx: bilinear(x[bi], yy, xx))(batch_idx, ys, xs)
+    # average pool sr x sr sampling points per output bin
+    sampled = sampled.reshape(R, C, ph, sr, pw, sr)
+    return sampled.mean(axis=(3, 5))
+
+
+ops.register("roi_align", _roi_align_impl, amp="deny")
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return ops.call("roi_align", _t(x), _t(boxes), _t(boxes_num),
+                    output_size=tuple(output_size),
+                    spatial_scale=float(spatial_scale),
+                    sampling_ratio=int(sampling_ratio),
+                    aligned=bool(aligned))
+
+
+def _roi_pool_impl(x, boxes, boxes_num, output_size, spatial_scale):
+    """Max-pool RoI pooling (quantized bins, reference roi_pool)."""
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    ph, pw = output_size
+    batch_idx = jnp.repeat(jnp.arange(N), boxes_num, axis=0,
+                           total_repeat_length=R)
+    bx = jnp.round(boxes * spatial_scale)
+    # clamp the RoI to the feature-map bounds (reference semantics) so
+    # out-of-image bins pool real values, never the -inf sentinel
+    x1 = jnp.clip(bx[:, 0].astype(jnp.int32), 0, W - 1)
+    y1 = jnp.clip(bx[:, 1].astype(jnp.int32), 0, H - 1)
+    x2 = jnp.clip(jnp.maximum(bx[:, 2].astype(jnp.int32), x1 + 1), 1, W)
+    y2 = jnp.clip(jnp.maximum(bx[:, 3].astype(jnp.int32), y1 + 1), 1, H)
+
+    # dense approach: for each output bin take max over a masked region
+    ys = jnp.arange(H)
+    xs = jnp.arange(W)
+
+    def one_roi(bi, px1, py1, px2, py2):
+        img = x[bi]  # [C, H, W]
+        rh = (py2 - py1).astype(jnp.float32) / ph
+        rw = (px2 - px1).astype(jnp.float32) / pw
+        hs = py1 + jnp.floor(jnp.arange(ph) * rh).astype(jnp.int32)
+        he = py1 + jnp.ceil((jnp.arange(ph) + 1) * rh).astype(jnp.int32)
+        ws = px1 + jnp.floor(jnp.arange(pw) * rw).astype(jnp.int32)
+        we = px1 + jnp.ceil((jnp.arange(pw) + 1) * rw).astype(jnp.int32)
+        ymask = (ys[None, :] >= hs[:, None]) & (ys[None, :] < he[:, None])
+        xmask = (xs[None, :] >= ws[:, None]) & (xs[None, :] < we[:, None])
+        m = ymask[:, None, :, None] & xmask[None, :, None, :]  # [ph,pw,H,W]
+        neg = jnp.asarray(-3.4e38, x.dtype)
+        vals = jnp.where(m[None], img[:, None, None, :, :], neg)
+        return vals.max(axis=(-1, -2))
+
+    import jax
+    return jax.vmap(one_roi)(batch_idx, x1, y1, x2, y2)
+
+
+ops.register("roi_pool", _roi_pool_impl, amp="deny")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return ops.call("roi_pool", _t(x), _t(boxes), _t(boxes_num),
+                    output_size=tuple(output_size),
+                    spatial_scale=float(spatial_scale))
+
+
+# ------------------------------------------------------------------ box_coder
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0):
+    """Encode/decode boxes against priors (reference box_coder op,
+    SSD-style)."""
+    pb = _t(prior_box)._array
+    tb = _t(target_box)._array
+    if prior_box_var is None:
+        var = jnp.ones((4,), jnp.float32)
+    else:
+        var = _t(prior_box_var)._array
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph_ = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph_ * 0.5
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        out = jnp.stack([
+            (tcx[:, None] - pcx[None, :]) / pw[None, :],
+            (tcy[:, None] - pcy[None, :]) / ph_[None, :],
+            jnp.log(tw[:, None] / pw[None, :]),
+            jnp.log(th[:, None] / ph_[None, :]),
+        ], -1)
+        out = out / (var.reshape(1, -1, 4) if var.ndim == 2
+                     else var.reshape(1, 1, 4))
+        return Tensor._from_array(out)
+    elif code_type == "decode_center_size":
+        # tb: [N, M, 4] or broadcastable; priors along `axis`
+        if tb.ndim == 2:
+            tb_ = tb[:, None, :]
+        else:
+            tb_ = tb
+        v = var.reshape(1, -1, 4) if var.ndim == 2 else var.reshape(1, 1, 4)
+        d = tb_ * v
+        if axis == 0:
+            pw_, ph2, pcx_, pcy_ = (pw[:, None], ph_[:, None],
+                                    pcx[:, None], pcy[:, None])
+        else:
+            pw_, ph2, pcx_, pcy_ = (pw[None, :], ph_[None, :],
+                                    pcx[None, :], pcy[None, :])
+        cx = d[..., 0] * pw_ + pcx_
+        cy = d[..., 1] * ph2 + pcy_
+        w = jnp.exp(d[..., 2]) * pw_
+        h = jnp.exp(d[..., 3]) * ph2
+        out = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                         cx + w * 0.5 - norm, cy + h * 0.5 - norm], -1)
+        if tb.ndim == 2:   # we added the prior axis — remove only it
+            out = jnp.squeeze(out, axis=1)
+        return Tensor._from_array(out)
+    raise ValueError(f"unknown code_type {code_type!r}")

@@ -1,0 +1,126 @@
+"""paddle.vision.ops tests (nms/roi_align/roi_pool/box_coder vs
+hand-computed references)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.vision import ops as vops
+
+
+def test_box_iou():
+    a = pt.to_tensor(np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32))
+    iou = vops.box_iou(a, a).numpy()
+    np.testing.assert_allclose(np.diag(iou), [1.0, 1.0], rtol=1e-5)
+    np.testing.assert_allclose(iou[0, 1], 1 / 7, rtol=1e-4)
+
+
+def test_nms_basic():
+    boxes = pt.to_tensor(np.array([
+        [0, 0, 10, 10],      # score .9  kept
+        [1, 1, 11, 11],      # score .8  suppressed by 0 (iou ~ .68)
+        [20, 20, 30, 30],    # score .7  kept (disjoint)
+        [0, 0, 10, 10],      # score .1  suppressed by 0
+    ], np.float32))
+    scores = pt.to_tensor(np.array([0.9, 0.8, 0.7, 0.1], np.float32))
+    keep = vops.nms(boxes, scores, iou_threshold=0.5).numpy()
+    np.testing.assert_array_equal(keep, [0, 2])
+
+
+def test_nms_categories_and_topk():
+    boxes = pt.to_tensor(np.array([
+        [0, 0, 10, 10], [1, 1, 11, 11], [0, 0, 10, 10],
+    ], np.float32))
+    scores = pt.to_tensor(np.array([0.9, 0.8, 0.7], np.float32))
+    cidx = pt.to_tensor(np.array([0, 1, 0]))
+    # classes 0 and 1 don't suppress each other
+    keep = vops.nms(boxes, scores, iou_threshold=0.5,
+                    category_idxs=cidx, categories=[0, 1]).numpy()
+    np.testing.assert_array_equal(keep, [0, 1])
+    keep = vops.nms(boxes, scores, iou_threshold=0.5,
+                    category_idxs=cidx, categories=[0, 1], top_k=1).numpy()
+    np.testing.assert_array_equal(keep, [0])
+
+
+def test_roi_align_identity():
+    """RoI covering one exact cell center grid reproduces bilinear values."""
+    H = W = 4
+    x = pt.to_tensor(np.arange(H * W, dtype=np.float32).reshape(1, 1, H, W))
+    boxes = pt.to_tensor(np.array([[0, 0, 4, 4]], np.float32))
+    out = vops.roi_align(x, boxes, pt.to_tensor(np.array([1])),
+                         output_size=4, sampling_ratio=1, aligned=True)
+    assert tuple(out.shape) == (1, 1, 4, 4)
+    # sampling points hit exact pixel centers -> identity
+    np.testing.assert_allclose(out.numpy()[0, 0], x.numpy()[0, 0],
+                               rtol=1e-4)
+
+
+def test_roi_align_multi_batch_routing():
+    x = np.zeros((2, 1, 4, 4), np.float32)
+    x[0] += 1.0
+    x[1] += 5.0
+    boxes = pt.to_tensor(np.array([[0, 0, 4, 4], [0, 0, 4, 4]], np.float32))
+    out = vops.roi_align(pt.to_tensor(x), boxes,
+                         pt.to_tensor(np.array([1, 1])), output_size=2)
+    np.testing.assert_allclose(out.numpy()[0], 1.0, rtol=1e-5)
+    np.testing.assert_allclose(out.numpy()[1], 5.0, rtol=1e-5)
+
+
+def test_roi_pool_max():
+    x = pt.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    boxes = pt.to_tensor(np.array([[0, 0, 4, 4]], np.float32))
+    out = vops.roi_pool(x, boxes, pt.to_tensor(np.array([1])),
+                        output_size=2)
+    np.testing.assert_allclose(out.numpy()[0, 0], [[5, 7], [13, 15]])
+
+
+def test_box_coder_roundtrip():
+    priors = pt.to_tensor(np.array([[0, 0, 10, 10], [5, 5, 15, 15]],
+                                   np.float32))
+    targets = pt.to_tensor(np.array([[1, 1, 9, 9], [6, 4, 14, 16]],
+                                    np.float32))
+    enc = vops.box_coder(priors, None, targets,
+                         code_type="encode_center_size")
+    dec = vops.box_coder(priors, None, enc,
+                         code_type="decode_center_size", axis=1)
+    # decode(encode(t)) against each prior's own row reproduces the target
+    d = dec.numpy()
+    np.testing.assert_allclose(d[0, 0], targets.numpy()[0], atol=1e-4)
+    np.testing.assert_allclose(d[1, 1], targets.numpy()[1], atol=1e-4)
+
+
+def test_roi_align_differentiable():
+    x = pt.to_tensor(np.random.RandomState(0).randn(1, 2, 8, 8)
+                     .astype(np.float32))
+    x.stop_gradient = False
+    boxes = pt.to_tensor(np.array([[1, 1, 6, 6]], np.float32))
+    out = vops.roi_align(x, boxes, pt.to_tensor(np.array([1])),
+                         output_size=3)
+    out.sum().backward()
+    assert x.grad is not None and np.abs(x.grad.numpy()).sum() > 0
+
+
+def test_roi_pool_out_of_bounds_clamps():
+    x = pt.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    boxes = pt.to_tensor(np.array([[0, 0, 4, 8]], np.float32))  # past H
+    out = vops.roi_pool(x, boxes, pt.to_tensor(np.array([1])),
+                        output_size=2).numpy()
+    assert out.min() >= 0.0  # no -inf sentinel leaks
+
+
+def test_reshard_keeps_gradient():
+    import paddle_tpu.distributed as dist
+    mesh = dist.ProcessMesh(list(range(8)), dim_names=["x"])
+    x = pt.randn([8, 4]); x.stop_gradient = False
+    y = dist.reshard(x, mesh, [dist.Shard(0)])
+    (y ** 2).sum().backward()
+    assert x.grad is not None
+    np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy(), rtol=1e-5)
+
+
+def test_box_coder_single_box_rank():
+    priors = pt.to_tensor(np.array([[0, 0, 10, 10]], np.float32))
+    targets = pt.to_tensor(np.array([[1, 1, 9, 9]], np.float32))
+    enc = vops.box_coder(priors, None, targets)
+    assert tuple(enc.shape) == (1, 1, 4)
+    dec = vops.box_coder(priors, None, enc, code_type="decode_center_size")
+    assert tuple(dec.shape) == (1, 1, 4)  # rank stable even at N=M=1
