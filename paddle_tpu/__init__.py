@@ -36,6 +36,7 @@ from . import metric  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
 from . import distribution  # noqa: F401
+from . import quantization  # noqa: F401
 from . import hapi  # noqa: F401
 from . import callbacks  # noqa: F401
 from .hapi import Model  # noqa: F401

@@ -1,0 +1,280 @@
+"""Quantization (reference: python/paddle/quantization — QuantConfig, QAT,
+PTQ, quanted layers).
+
+TPU-native: fake-quant is a `jax.custom_vjp` op (straight-through
+estimator) registered in the dispatch table, so QAT trains through the
+usual tape/jit paths; PTQ observers are ordinary buffers (the functional
+bridge captures their mutation under jit, like BN stats); `convert()`
+freezes scales and stores int8 weights, and the int8 path accumulates in
+int32 via `lax.dot_general(preferred_element_type=int32)` — the MXU's
+native int8 mode.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import nn
+from ..nn import functional as F
+from ..ops import dispatch as ops
+from ..tensor import Tensor
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMax",
+           "AbsmaxObserver", "QuantedLinear", "QuantedConv2D",
+           "Int8Linear", "quant_absmax", "fake_quantize"]
+
+
+# ------------------------------------------------------------ fake quant op
+@jax.custom_vjp
+def _fake_quant(x, scale):
+    """Symmetric int8 quantize-dequantize."""
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * 127.0), -127.0, 127.0)
+    return q * s / 127.0
+
+
+def _fq_fwd(x, scale):
+    return _fake_quant(x, scale), (x, scale)
+
+
+def _fq_bwd(res, g):
+    x, scale = res
+    s = jnp.maximum(scale, 1e-8)
+    # straight-through inside the clip range, zero outside
+    mask = (jnp.abs(x) <= s).astype(g.dtype)
+    return g * mask, jnp.zeros_like(scale)
+
+
+_fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+ops.register("fake_quant_absmax",
+             lambda x, scale=None: _fake_quant(x, scale), amp="deny")
+
+
+def fake_quantize(x, scale):
+    """Quantize-dequantize with STE gradient (QAT building block)."""
+    from ..tensor_api import _t
+    t = _t(x)
+    s = scale._array if isinstance(scale, Tensor) else \
+        jnp.asarray(scale, jnp.float32)
+    return ops.call("fake_quant_absmax", t, scale=s)
+
+
+def quant_absmax(x):
+    arr = x._array if isinstance(x, Tensor) else jnp.asarray(x)
+    return float(jnp.max(jnp.abs(arr)))
+
+
+# -------------------------------------------------------------- quanters
+class FakeQuanterWithAbsMax(nn.Layer):
+    """QAT activation/weight quanter: EMA absmax scale buffer + fake
+    quant with STE (reference: FakeQuanterWithAbsMaxObserver)."""
+
+    def __init__(self, moving_rate=0.9):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.register_buffer("scale", Tensor(jnp.ones((), jnp.float32)))
+        self.register_buffer("initialized",
+                             Tensor(jnp.zeros((), jnp.float32)))
+
+    def forward(self, x):
+        cur = x.abs().max().astype("float32")
+        if self.training:
+            r = self.moving_rate
+            init = self.initialized
+            new_scale = init * (self.scale * r + cur * (1 - r)) \
+                + (1.0 - init) * cur
+            self.scale.set_value(new_scale)
+            self.initialized.set_value(Tensor(jnp.ones((), jnp.float32)))
+            scale = new_scale
+        else:
+            scale = self.scale
+        return fake_quantize(x, scale)
+
+
+class AbsmaxObserver(nn.Layer):
+    """PTQ observer: tracks running max |x| without changing values."""
+
+    def __init__(self):
+        super().__init__()
+        self.register_buffer("scale", Tensor(jnp.zeros((), jnp.float32)))
+
+    def forward(self, x):
+        cur = x.abs().max().astype("float32")
+        self.scale.set_value(self.scale.maximum(cur))
+        return x
+
+
+# ---------------------------------------------------------- quanted layers
+class QuantedLinear(nn.Layer):
+    """Linear with weight + activation quanters (QAT) or observers (PTQ)."""
+
+    def __init__(self, layer, act_quanter, w_quanter):
+        super().__init__()
+        self.inner = layer
+        self.act_q = act_quanter
+        self.w_q = w_quanter
+
+    def forward(self, x):
+        x = self.act_q(x)
+        w = self.w_q(self.inner.weight)
+        return F.linear(x, w, self.inner.bias)
+
+
+class QuantedConv2D(nn.Layer):
+    def __init__(self, layer, act_quanter, w_quanter):
+        super().__init__()
+        self.inner = layer
+        self.act_q = act_quanter
+        self.w_q = w_quanter
+
+    def forward(self, x):
+        x = self.act_q(x)
+        w = self.w_q(self.inner.weight)
+        L = self.inner
+        return F.conv2d(x, w, bias=L.bias, stride=L.stride,
+                        padding=L.padding, dilation=L.dilation,
+                        groups=L.groups)
+
+
+class Int8Linear(nn.Layer):
+    """Converted inference layer: int8 weights + fp scales; the matmul
+    runs int8 x int8 -> int32 on the MXU, dequantized once at the end."""
+
+    def __init__(self, layer, w_scale, act_scale):
+        super().__init__()
+        w = layer.weight._array
+        s = max(w_scale, 1e-8)
+        w_q = jnp.clip(jnp.round(w / s * 127.0), -127, 127) \
+            .astype(jnp.int8)
+        self.register_buffer("w_int8", Tensor(w_q))
+        self.register_buffer("w_scale",
+                             Tensor(jnp.asarray(s, jnp.float32)))
+        self.register_buffer("act_scale",
+                             Tensor(jnp.asarray(max(act_scale, 1e-8),
+                                                jnp.float32)))
+        self.bias = layer.bias
+
+    def forward(self, x):
+        a_s = self.act_scale._array
+        w_s = self.w_scale._array
+        x_q = jnp.clip(jnp.round(x._array / a_s * 127.0), -127, 127) \
+            .astype(jnp.int8)
+        acc = lax.dot_general(
+            x_q, self.w_int8._array,
+            dimension_numbers=(((x_q.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * (a_s * w_s / (127.0 * 127.0))
+        out = Tensor._from_array(out.astype(x._array.dtype))
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+# ------------------------------------------------------------------ config
+class QuantConfig:
+    """reference: paddle.quantization.QuantConfig — which layers get which
+    quanters.  `activation`/`weight` are factories (callables) returning a
+    quanter layer; add_type_config overrides them per layer type."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation or (lambda: FakeQuanterWithAbsMax())
+        self.weight = weight or (lambda: FakeQuanterWithAbsMax())
+        self._types = (nn.Linear, nn.Conv2D)
+        self._per_type = {}
+
+    def add_type_config(self, types, activation=None, weight=None):
+        types = tuple(types) if isinstance(types, (list, tuple)) \
+            else (types,)
+        for t in types:
+            self._per_type[t] = (activation or self.activation,
+                                 weight or self.weight)
+        self._types = tuple(set(self._types) | set(types))
+
+    def factories_for(self, layer):
+        act, w = self._per_type.get(type(layer), (self.activation,
+                                                  self.weight))
+        return act, w
+
+
+def _swap_layers(model, cfg, make):
+    for name, child in list(model._sub_layers.items()):
+        if isinstance(child, cfg._types):
+            setattr(model, name, make(child))
+        else:
+            _swap_layers(child, cfg, make)
+    return model
+
+
+class QAT:
+    """Quantization-aware training driver (reference: paddle.quantization.
+    QAT): wraps matching layers with fake-quant; train as usual; convert()
+    freezes to int8 inference layers."""
+
+    def __init__(self, config=None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=True):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        cfg = self.config
+
+        def make(layer):
+            q_cls = QuantedConv2D if isinstance(layer, nn.Conv2D) \
+                else QuantedLinear
+            act_f, w_f = cfg.factories_for(layer)
+            return q_cls(layer, act_f(), w_f())
+
+        return _swap_layers(model, cfg, make)
+
+    def convert(self, model, inplace=True):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        def act_scale_of(child, what):
+            s = float(child.act_q.scale)
+            if s <= 0.0:
+                raise ValueError(
+                    f"{what} has an uncalibrated activation scale (0.0) — "
+                    "run training (QAT) or calibration forwards (PTQ) "
+                    "before convert()")
+            return s
+
+        def conv(m):
+            for name, child in list(m._sub_layers.items()):
+                if isinstance(child, QuantedLinear):
+                    w_scale = (float(child.w_q.scale)
+                               if hasattr(child.w_q, "scale") else 0.0) \
+                        or quant_absmax(child.inner.weight)
+                    setattr(m, name, Int8Linear(
+                        child.inner, w_scale,
+                        act_scale_of(child, f"QuantedLinear '{name}'")))
+                elif isinstance(child, QuantedConv2D):
+                    # conv int8 matmuls lower less uniformly in XLA than
+                    # dots: fold the weight fake-quant into the float conv
+                    # and drop the runtime observers/quanters
+                    inner = child.inner
+                    w_scale = (float(child.w_q.scale)
+                               if hasattr(child.w_q, "scale") else 0.0) \
+                        or quant_absmax(inner.weight)
+                    inner.weight.set_value(
+                        fake_quantize(inner.weight, w_scale))
+                    setattr(m, name, inner)
+                else:
+                    conv(child)
+            return m
+        return conv(model)
+
+
+class PTQ(QAT):
+    """Post-training quantization: observers collect absmax during
+    calibration forward passes (model.eval()), then convert()."""
+
+    def __init__(self, config=None):
+        if config is None:
+            config = QuantConfig(activation=AbsmaxObserver,
+                                 weight=AbsmaxObserver)
+        super().__init__(config)

@@ -1,0 +1,126 @@
+"""paddle.quantization tests: QAT fake-quant + STE, PTQ observers,
+int8 conversion (reference: python/paddle/quantization)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import quantization as Q
+
+
+def test_fake_quantize_values_and_ste():
+    x = pt.to_tensor(np.array([-2.0, -0.5, 0.3, 1.0], np.float32))
+    x.stop_gradient = False
+    y = Q.fake_quantize(x, 1.0)
+    # values snapped to the int8 grid of scale 1.0, clipped to [-1, 1]
+    np.testing.assert_allclose(
+        y.numpy(), [-1.0, -0.5039, 0.2992, 1.0], atol=2e-3)
+    y.sum().backward()
+    # STE: passthrough inside |x|<=scale, zero outside
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 1.0, 1.0, 1.0])
+
+
+def test_qat_quantize_and_train():
+    pt.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    qat = Q.QAT()
+    m = qat.quantize(m)
+    assert isinstance(m[0], Q.QuantedLinear)
+    opt = pt.optimizer.Adam(learning_rate=5e-3, parameters=m.parameters())
+    step = pt.jit.train_step(m, lambda mm, a, b: F.mse_loss(mm(a), b), opt)
+    x = pt.randn([16, 8]); y = pt.randn([16, 4])
+    losses = [float(step(x, y)) for _ in range(40)]
+    assert losses[-1] < losses[0] * 0.5   # trains through fake-quant
+    assert float(m[0].act_q.scale) > 0    # EMA buffer updated under jit
+
+
+def test_qat_convert_int8_close_to_float():
+    pt.seed(1)
+    m = nn.Sequential(nn.Linear(8, 8))
+    x = pt.randn([4, 8])
+    qat = Q.QAT()
+    mq = qat.quantize(m)
+    mq.train()
+    mq(x)          # update scales
+    mq.eval()
+    ref = mq(x).numpy()
+    conv = qat.convert(mq)
+    assert isinstance(conv[0], Q.Int8Linear)
+    out = conv(x).numpy()
+    # int8 path matches the fake-quant reference closely
+    assert np.abs(out - ref).max() < 0.06
+    assert conv[0].w_int8.dtype == pt.int8 or \
+        str(conv[0].w_int8._array.dtype) == "int8"
+
+
+def test_ptq_calibrate_and_convert():
+    pt.seed(2)
+    m = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+    ref_in = pt.randn([32, 8])
+    m.eval()
+    ref = m(ref_in).numpy()
+    ptq = Q.PTQ()
+    mq = ptq.quantize(m)
+    mq.eval()
+    for i in range(4):                      # calibration passes
+        mq(ref_in[i * 8:(i + 1) * 8])
+    assert float(mq[0].act_q.scale) > 0
+    conv = ptq.convert(mq)
+    out = conv(ref_in).numpy()
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.08                       # int8 PTQ error bound
+
+
+def test_int8_linear_uses_int32_accumulation():
+    import jax.numpy as jnp
+    pt.seed(3)
+    lin = nn.Linear(64, 4)
+    lin.weight.set_value(pt.ones([64, 4]))
+    il = Q.Int8Linear(lin, w_scale=1.0, act_scale=1.0)
+    x = pt.ones([1, 64])
+    out = il(x)
+    # 64 * (127*127) would overflow int8/int16 paths; int32 accum is exact
+    expected = 64 * (127.0 / 127.0) * (127.0 / 127.0)
+    np.testing.assert_allclose(out.numpy()[0, 0] - float(lin.bias[0]),
+                               expected, rtol=1e-2)
+
+
+def test_inplace_false_preserves_float_model():
+    pt.seed(4)
+    m = nn.Sequential(nn.Linear(4, 4))
+    qat = Q.QAT()
+    mq = qat.quantize(m, inplace=False)
+    assert isinstance(mq[0], Q.QuantedLinear)
+    assert isinstance(m[0], nn.Linear)       # original untouched
+    x = pt.randn([2, 4])
+    m(x)  # still the float graph
+
+
+def test_convert_uncalibrated_raises():
+    m = nn.Sequential(nn.Linear(4, 4))
+    ptq = Q.PTQ()
+    mq = ptq.quantize(m)
+    with pytest.raises(ValueError, match="uncalibrated"):
+        ptq.convert(mq)
+
+
+def test_per_type_config():
+    cfg = Q.QuantConfig()
+    cfg.add_type_config(nn.Conv2D, activation=Q.AbsmaxObserver)
+    m = nn.Sequential(nn.Linear(4, 4), nn.Conv2D(1, 1, 3))
+    mq = Q.QAT(cfg).quantize(m)
+    assert isinstance(mq[0], Q.QuantedLinear)      # Linear still quantized
+    assert isinstance(mq[0].act_q, Q.FakeQuanterWithAbsMax)
+    assert isinstance(mq[1].act_q, Q.AbsmaxObserver)  # per-type override
+
+
+def test_convert_unwraps_conv():
+    pt.seed(5)
+    m = nn.Sequential(nn.Conv2D(1, 2, 3, padding=1), nn.ReLU())
+    ptq = Q.PTQ()
+    mq = ptq.quantize(m)
+    mq.eval()
+    mq(pt.randn([1, 1, 8, 8]))
+    conv = ptq.convert(mq)
+    assert isinstance(conv[0], nn.Conv2D)    # observers gone
