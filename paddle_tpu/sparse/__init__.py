@@ -1,0 +1,217 @@
+"""Sparse tensors (reference: python/paddle/sparse — COO/CSR formats,
+elementwise and matmul ops).
+
+TPU-native: backed by jax.experimental.sparse.BCOO (batched-COO, the
+format XLA lowers to gather/scatter/segment-sum programs).  CSR inputs
+are converted to COO at construction (one cumsum expansion) and can be
+exported back; compute happens in BCOO.  Point-cloud sparse convs
+(Conv3D submanifold) are out of scope and raise.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..tensor import Tensor
+
+__all__ = ["SparseCooTensor", "sparse_coo_tensor", "sparse_csr_tensor",
+           "is_same_shape", "add", "subtract", "multiply", "divide",
+           "matmul", "masked_matmul", "relu", "transpose", "to_dense",
+           "nnz"]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._array
+    return jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor (reference: paddle's sparse_coo place tensors)."""
+
+    def __init__(self, bcoo, coalesced=False):
+        self._bcoo = bcoo
+        self._coalesced = coalesced
+
+    # ------------------------------------------------------------- factory
+    @staticmethod
+    def from_dense(x):
+        return SparseCooTensor(jsparse.BCOO.fromdense(_arr(x)))
+
+    # ------------------------------------------------------------ accessors
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.data.dtype
+
+    def indices(self):
+        """[ndim, nnz] (reference layout; BCOO stores [nnz, ndim])."""
+        return Tensor._from_array(self._bcoo.indices.T)
+
+    def values(self):
+        return Tensor._from_array(self._bcoo.data)
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def to_dense(self):
+        return Tensor._from_array(self._bcoo.todense())
+
+    def coalesce(self):
+        s = self._bcoo.sum_duplicates(remove_zeros=False)
+        return SparseCooTensor(s, coalesced=True)
+
+    def transpose(self, perm):
+        return SparseCooTensor(
+            jsparse.bcoo_transpose(self._bcoo, permutation=tuple(perm)))
+
+    def astype(self, dtype):
+        from ..dtypes import convert_dtype
+        d = convert_dtype(dtype)
+        return SparseCooTensor(
+            jsparse.BCOO((self._bcoo.data.astype(d), self._bcoo.indices),
+                         shape=self._bcoo.shape))
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True):
+    """Build a COO tensor from [ndim, nnz] indices + [nnz] values."""
+    idx = _arr(indices).T.astype(jnp.int32)   # -> [nnz, ndim]
+    vals = _arr(values)
+    if dtype is not None:
+        from ..dtypes import convert_dtype
+        vals = vals.astype(convert_dtype(dtype))
+    if shape is None:
+        if idx.shape[0] == 0:
+            raise ValueError(
+                "shape is required for an empty (nnz=0) sparse tensor")
+        shape = tuple(int(i) for i in (idx.max(0) + 1))
+    return SparseCooTensor(jsparse.BCOO((vals, idx),
+                                        shape=tuple(int(s) for s in shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None):
+    """Build from CSR (crows [nrows+1], cols [nnz]); stored as COO."""
+    crows = _arr(crows).astype(jnp.int32)
+    cols = _arr(cols).astype(jnp.int32)
+    vals = _arr(values)
+    counts = crows[1:] - crows[:-1]
+    rows = jnp.repeat(jnp.arange(counts.shape[0], dtype=jnp.int32), counts,
+                      total_repeat_length=int(cols.shape[0]))
+    idx = jnp.stack([rows, cols])
+    return sparse_coo_tensor(idx, vals, shape, dtype)
+
+
+def _coo(x):
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    raise TypeError(f"expected SparseCooTensor, got {type(x).__name__}")
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+# -------------------------------------------------------------- elementwise
+def add(x, y):
+    if isinstance(y, SparseCooTensor):
+        return SparseCooTensor((_coo(x) + _coo(y)).sum_duplicates(
+            remove_zeros=False))
+    return Tensor._from_array(_coo(x).todense() + _arr(y))
+
+
+def subtract(x, y):
+    if isinstance(y, SparseCooTensor):
+        yneg = jsparse.BCOO((-_coo(y).data, _coo(y).indices),
+                            shape=_coo(y).shape)
+        return SparseCooTensor((_coo(x) + yneg).sum_duplicates(
+            remove_zeros=False))
+    return Tensor._from_array(_coo(x).todense() - _arr(y))
+
+
+def _gather_at_pattern(b, y):
+    """Values of (dense or sparse) y at b's index pattern, with numpy-style
+    broadcasting of y up to b.shape."""
+    yd = y._bcoo.todense() if isinstance(y, SparseCooTensor) else _arr(y)
+    yd = jnp.broadcast_to(yd, b.shape)
+    return yd[tuple(b.indices[:, i] for i in range(b.indices.shape[1]))]
+
+
+def multiply(x, y):
+    """Sparse * scalar/dense/sparse: elementwise at x's pattern (zeros of
+    x stay zero; sparse y contributes its dense extension, so the result's
+    support is the intersection)."""
+    b = _coo(x)
+    if isinstance(y, (int, float)) or (hasattr(y, "ndim") and y.ndim == 0):
+        return SparseCooTensor(jsparse.BCOO((b.data * float(y), b.indices),
+                                            shape=b.shape))
+    gathered = _gather_at_pattern(b, y)
+    return SparseCooTensor(jsparse.BCOO((b.data * gathered, b.indices),
+                                        shape=b.shape))
+
+
+def divide(x, y):
+    b = _coo(x)
+    if isinstance(y, (int, float)) or (hasattr(y, "ndim") and y.ndim == 0):
+        return multiply(x, 1.0 / float(y))
+    gathered = _gather_at_pattern(b, y)
+    return SparseCooTensor(jsparse.BCOO((b.data / gathered, b.indices),
+                                        shape=b.shape))
+
+
+def relu(x):
+    b = _coo(x)
+    return SparseCooTensor(jsparse.BCOO((jax.nn.relu(b.data), b.indices),
+                                        shape=b.shape))
+
+
+# ------------------------------------------------------------------- matmul
+def matmul(x, y):
+    """sparse @ dense -> dense (SpMM; XLA lowers the BCOO dot to
+    gather+segment-sum)."""
+    if isinstance(x, SparseCooTensor):
+        out = x._bcoo @ _arr(y)
+        return Tensor._from_array(out)
+    if isinstance(y, SparseCooTensor):
+        out = _arr(x) @ y._bcoo
+        return Tensor._from_array(out)
+    raise TypeError("matmul needs at least one SparseCooTensor")
+
+
+def masked_matmul(x, y, mask):
+    """(x @ y) sampled at mask's sparsity pattern (SDDMM); 2-D or batched
+    3-D (mask indices [nnz, 3] = (batch, row, col))."""
+    xb, yb = _arr(x), _arr(y)
+    m = _coo(mask)
+    nd = m.indices.shape[1]
+    if nd == 2:
+        rows, cols = m.indices[:, 0], m.indices[:, 1]
+        vals = jnp.einsum("nk,nk->n", xb[rows], yb.T[cols])
+    elif nd == 3:
+        bidx = m.indices[:, 0]
+        rows, cols = m.indices[:, 1], m.indices[:, 2]
+        vals = jnp.einsum("nk,nk->n", xb[bidx, rows, :],
+                          yb[bidx, :, cols])
+    else:
+        raise ValueError(f"masked_matmul supports 2-D/3-D masks, got {nd}-D")
+    return SparseCooTensor(jsparse.BCOO((vals, m.indices), shape=m.shape))
+
+
+def transpose(x, perm):
+    return x.transpose(perm)
+
+
+def to_dense(x):
+    return x.to_dense()
+
+
+def nnz(x):
+    return x.nnz()

@@ -1,0 +1,129 @@
+"""paddle.sparse tests (COO/CSR over jax BCOO)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import sparse as S
+
+
+def _coo_example():
+    # [[1, 0, 2], [0, 3, 0]]
+    idx = np.array([[0, 0, 1], [0, 2, 1]])
+    vals = np.array([1.0, 2.0, 3.0], np.float32)
+    return S.sparse_coo_tensor(idx, vals, [2, 3])
+
+
+def test_coo_roundtrip():
+    t = _coo_example()
+    assert t.shape == [2, 3] and t.nnz() == 3
+    dense = t.to_dense().numpy()
+    np.testing.assert_allclose(dense, [[1, 0, 2], [0, 3, 0]])
+    np.testing.assert_allclose(t.values().numpy(), [1, 2, 3])
+    assert t.indices().numpy().shape == (2, 3)
+
+
+def test_csr_construction():
+    # same matrix in CSR
+    t = S.sparse_csr_tensor(crows=[0, 2, 3], cols=[0, 2, 1],
+                            values=np.array([1.0, 2.0, 3.0], np.float32),
+                            shape=[2, 3])
+    np.testing.assert_allclose(t.to_dense().numpy(),
+                               [[1, 0, 2], [0, 3, 0]])
+
+
+def test_from_dense_and_elementwise():
+    d = np.array([[0, 1], [2, 0]], np.float32)
+    t = S.SparseCooTensor.from_dense(pt.to_tensor(d))
+    assert t.nnz() == 2
+    s2 = S.add(t, t)
+    np.testing.assert_allclose(s2.to_dense().numpy(), 2 * d)
+    s3 = S.subtract(s2, t)
+    np.testing.assert_allclose(s3.to_dense().numpy(), d)
+    s4 = S.multiply(t, 3.0)
+    np.testing.assert_allclose(s4.to_dense().numpy(), 3 * d)
+
+
+def test_multiply_dense_mask_semantics():
+    t = _coo_example()
+    y = np.full((2, 3), 2.0, np.float32)
+    out = S.multiply(t, y)
+    np.testing.assert_allclose(out.to_dense().numpy(),
+                               [[2, 0, 4], [0, 6, 0]])
+
+
+def test_spmm_and_dense_matmul():
+    t = _coo_example()
+    w = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    out = S.matmul(t, pt.to_tensor(w))
+    np.testing.assert_allclose(out.numpy(),
+                               t.to_dense().numpy() @ w, rtol=1e-5)
+
+
+def test_masked_matmul_sddmm():
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 8).astype(np.float32)
+    y = rng.randn(8, 4).astype(np.float32)
+    mask = S.SparseCooTensor.from_dense(
+        pt.to_tensor(np.eye(4, dtype=np.float32)))
+    out = S.masked_matmul(pt.to_tensor(x), pt.to_tensor(y), mask)
+    full = x @ y
+    np.testing.assert_allclose(out.to_dense().numpy(),
+                               np.eye(4) * full, rtol=1e-4)
+
+
+def test_relu_transpose_astype():
+    idx = np.array([[0, 1], [1, 0]])
+    t = S.sparse_coo_tensor(idx, np.array([-1.0, 2.0], np.float32), [2, 2])
+    r = S.relu(t)
+    np.testing.assert_allclose(r.to_dense().numpy(), [[0, 0], [2, 0]])
+    tt = S.transpose(t, [1, 0])
+    np.testing.assert_allclose(tt.to_dense().numpy(),
+                               t.to_dense().numpy().T)
+    t16 = t.astype("float16")
+    assert str(t16.dtype) == "float16"
+
+
+def test_coalesce_merges_duplicates():
+    idx = np.array([[0, 0], [0, 0]])  # same position twice
+    t = S.sparse_coo_tensor(idx, np.array([1.0, 2.0], np.float32), [1, 1])
+    c = t.coalesce()
+    np.testing.assert_allclose(c.to_dense().numpy(), [[3.0]])
+
+
+def test_sparse_times_sparse_and_broadcast():
+    t = _coo_example()          # [[1,0,2],[0,3,0]]
+    out = S.multiply(t, t)
+    np.testing.assert_allclose(out.to_dense().numpy(),
+                               [[1, 0, 4], [0, 9, 0]])
+    # row-broadcast dense operand
+    row = np.array([[2.0, 2.0, 2.0]], np.float32)   # [1, 3]
+    out = S.multiply(t, row)
+    np.testing.assert_allclose(out.to_dense().numpy(),
+                               [[2, 0, 4], [0, 6, 0]])
+    # 0-d numpy scalar hits the scalar path
+    out = S.multiply(t, np.float32(3.0))
+    np.testing.assert_allclose(out.to_dense().numpy(),
+                               [[3, 0, 6], [0, 9, 0]])
+    out = S.divide(t, t)
+    np.testing.assert_allclose(out.to_dense().numpy(),
+                               [[1, 0, 1], [0, 1, 0]])
+
+
+def test_empty_sparse_requires_shape():
+    with pytest.raises(ValueError, match="shape"):
+        S.sparse_coo_tensor(np.zeros((2, 0)), np.zeros((0,)))
+    t = S.sparse_coo_tensor(np.zeros((2, 0)), np.zeros((0,), np.float32),
+                            shape=[2, 2])
+    np.testing.assert_allclose(t.to_dense().numpy(), np.zeros((2, 2)))
+
+
+def test_masked_matmul_batched():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    y = rng.randn(2, 4, 3).astype(np.float32)
+    eye = np.stack([np.eye(3, dtype=np.float32)] * 2)
+    mask = S.SparseCooTensor.from_dense(pt.to_tensor(eye))
+    out = S.masked_matmul(pt.to_tensor(x), pt.to_tensor(y), mask)
+    full = np.einsum("bmk,bkn->bmn", x, y)
+    np.testing.assert_allclose(out.to_dense().numpy(), eye * full,
+                               rtol=1e-4)
