@@ -69,6 +69,38 @@ def create_parameter(shape, dtype=None, default_initializer=None,
     return t
 
 
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Exact forward FLOPs via XLA cost analysis (reference: paddle.flops
+    estimates per-layer formulas; here the compiler counts the real HLO).
+    input_size: shape list/tuple (with or without batch dim semantics —
+    passed through as-is)."""
+    import jax.numpy as jnp
+    from .jit import functional_bridge as FB
+    from . import profiler as _prof
+
+    modes = [(layer, layer.training)
+             for _, layer in net.named_sublayers(include_self=True)]
+    net.eval()
+    try:
+        pn, pa, bn, ba = FB.split_state(net)
+        x = jnp.zeros(tuple(input_size), jnp.float32)
+
+        def fwd(params, buffers, inp):
+            out, _ = FB.call_functional(net, params, buffers, (inp,))
+            return out
+
+        total = int(_prof.program_stats(fwd, pa, ba, x).get("flops", 0))
+    finally:
+        for layer, mode in modes:
+            layer.training = mode
+    if print_detail:
+        # NB: builtins.sum — the module-level `sum` is the tensor op
+        import builtins
+        n_params = builtins.sum(int(p.size) for p in net.parameters())
+        print(f"Total flops: {total:,}  params: {n_params:,}")
+    return total
+
+
 def summary(layer, input_size=None):
     n_params = sum(p.size for p in layer.parameters())
     print(f"{type(layer).__name__}: {n_params:,} parameters")

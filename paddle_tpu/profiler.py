@@ -1,17 +1,30 @@
 """Profiler / tracing subsystem (reference: python/paddle/profiler/).
 
 TPU-native: wraps jax.profiler (perfetto/xplane traces viewable in
-tensorboard or xprof) plus lightweight wall-clock step timers.
+tensorboard or xprof), plus host-side instruments the reference profiler
+also provides: named-event aggregation (RecordEvent -> summary table),
+a (start, end) step scheduler window for trace capture, step timing with
+throughput, and XLA cost-analysis program stats (exact flops/bytes from
+the compiler instead of estimated per-op tables).
 """
 from __future__ import annotations
 
+import collections
 import contextlib
 import time
 
 import jax
 
+_event_stats = collections.defaultdict(lambda: [0, 0.0, 0.0])  # n, tot, max
+
+
+def reset_events():
+    _event_stats.clear()
+
 
 class RecordEvent:
+    """Named scope: annotates the device trace AND aggregates host time."""
+
     def __init__(self, name):
         self.name = name
         self._ctx = None
@@ -25,43 +38,102 @@ class RecordEvent:
     def __exit__(self, *exc):
         self.end = time.perf_counter()
         self._ctx.__exit__(*exc)
+        dt = self.end - self.begin
+        s = _event_stats[self.name]
+        s[0] += 1
+        s[1] += dt
+        s[2] = max(s[2], dt)
         return False
 
 
+def make_scheduler(*, closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """Reference-style scheduler factory → (start_step, end_step) window
+    (single capture; repeat is accepted for API parity)."""
+    start = skip_first + closed + ready
+    return (start, start + record)
+
+
 class Profiler:
+    """profiler.Profiler(scheduler=(2, 5)) captures a device trace only
+    for steps [2, 5) while timing every step."""
+
     def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
                  timer_only=False, log_dir="./profiler_log"):
         self.log_dir = log_dir
         self.timer_only = timer_only
+        self.scheduler = tuple(scheduler) if scheduler is not None else None
+        self._step_idx = 0
         self._step_times = []
+        self._samples = []
         self._t0 = None
         self._started = False
+        self._tracing = False
+
+    # ------------------------------------------------------------- control
+    def _maybe_trace(self):
+        if self.timer_only:
+            return
+        if self.scheduler is None:
+            if not self._tracing:
+                jax.profiler.start_trace(self.log_dir)
+                self._tracing = True
+            return
+        lo, hi = self.scheduler
+        # stop-check first so a zero-width window (lo == hi) records nothing
+        if self._tracing and self._step_idx >= hi:
+            jax.profiler.stop_trace()
+            self._tracing = False
+        if not self._tracing and lo <= self._step_idx < hi:
+            jax.profiler.start_trace(self.log_dir)
+            self._tracing = True
 
     def start(self):
-        if not self.timer_only:
-            jax.profiler.start_trace(self.log_dir)
         self._started = True
+        self._step_idx = 0
+        self._step_times = []
+        self._samples = []
+        reset_events()   # each profiling session aggregates its own events
+        self._maybe_trace()
         self._t0 = time.perf_counter()
 
     def step(self, num_samples=None):
         t = time.perf_counter()
         if self._t0 is not None:
             self._step_times.append(t - self._t0)
+            self._samples.append(num_samples or 0)
         self._t0 = t
+        self._step_idx += 1
+        self._maybe_trace()
 
     def stop(self):
-        if self._started and not self.timer_only:
+        if self._started and self._tracing:
             jax.profiler.stop_trace()
+            self._tracing = False
         self._started = False
 
+    # ------------------------------------------------------------- reports
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
-        if not self._step_times:
-            return "no steps recorded"
-        times = self._step_times
-        avg = sum(times) / len(times)
-        return (f"steps={len(times)} avg={avg*1e3:.2f}ms "
-                f"min={min(times)*1e3:.2f}ms max={max(times)*1e3:.2f}ms")
+        lines = []
+        if self._step_times:
+            times = self._step_times
+            avg = sum(times) / len(times)
+            line = (f"steps={len(times)} avg={avg*1e3:.2f}ms "
+                    f"min={min(times)*1e3:.2f}ms max={max(times)*1e3:.2f}ms")
+            n_samples = sum(self._samples)
+            if n_samples:
+                line += f" throughput={n_samples / sum(times):.1f}/s"
+            lines.append(line)
+        else:
+            lines.append("no steps recorded")
+        if op_detail and _event_stats:
+            lines.append(f"{'event':<30} {'count':>7} {'total_ms':>10} "
+                         f"{'avg_ms':>9} {'max_ms':>9}")
+            items = sorted(_event_stats.items(), key=lambda kv: -kv[1][1])
+            for name, (n, tot, mx) in items:
+                lines.append(f"{name:<30} {n:>7} {tot*1e3:>10.2f} "
+                             f"{tot/n*1e3:>9.2f} {mx*1e3:>9.2f}")
+        return "\n".join(lines)
 
     def __enter__(self):
         self.start()
@@ -70,6 +142,22 @@ class Profiler:
     def __exit__(self, *exc):
         self.stop()
         return False
+
+
+def program_stats(fn, *args, **kwargs):
+    """Exact compiled-program stats from XLA cost analysis: dict with
+    flops, bytes accessed, and (when the backend reports it) estimated
+    seconds.  `fn` is any jax-traceable callable (e.g. a jitted step's
+    underlying function) called with example args."""
+    lowered = jax.jit(lambda *a: fn(*a, **kwargs)).lower(*args)
+    cost = lowered.compile().cost_analysis()
+    if not isinstance(cost, dict):
+        return {}
+    out = {"flops": cost.get("flops", 0.0)}
+    for k, v in cost.items():
+        if "bytes" in k or "optimal_seconds" in k:
+            out[k] = v
+    return out
 
 
 @contextlib.contextmanager

@@ -145,13 +145,19 @@ def _gather_at_pattern(b, y):
     return yd[tuple(b.indices[:, i] for i in range(b.indices.shape[1]))]
 
 
+def _is_scalar(y):
+    return isinstance(y, (int, float)) or (hasattr(y, "ndim")
+                                           and y.ndim == 0)
+
+
 def multiply(x, y):
     """Sparse * scalar/dense/sparse: elementwise at x's pattern (zeros of
     x stay zero; sparse y contributes its dense extension, so the result's
-    support is the intersection)."""
+    support is the intersection).  Scalars follow jnp weak-typing (int
+    sparse * int scalar stays integral)."""
     b = _coo(x)
-    if isinstance(y, (int, float)) or (hasattr(y, "ndim") and y.ndim == 0):
-        return SparseCooTensor(jsparse.BCOO((b.data * float(y), b.indices),
+    if _is_scalar(y):
+        return SparseCooTensor(jsparse.BCOO((b.data * y, b.indices),
                                             shape=b.shape))
     gathered = _gather_at_pattern(b, y)
     return SparseCooTensor(jsparse.BCOO((b.data * gathered, b.indices),
@@ -160,8 +166,9 @@ def multiply(x, y):
 
 def divide(x, y):
     b = _coo(x)
-    if isinstance(y, (int, float)) or (hasattr(y, "ndim") and y.ndim == 0):
-        return multiply(x, 1.0 / float(y))
+    if _is_scalar(y):
+        return SparseCooTensor(jsparse.BCOO((b.data / y, b.indices),
+                                            shape=b.shape))
     gathered = _gather_at_pattern(b, y)
     return SparseCooTensor(jsparse.BCOO((b.data / gathered, b.indices),
                                         shape=b.shape))

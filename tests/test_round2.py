@@ -279,3 +279,61 @@ def test_dataloader_buffer_reader_values_and_lookahead(monkeypatch):
     dl2 = io.DataLoader(DS(), batch_size=2, use_buffer_reader=False)
     b0 = next(iter(dl2))
     np.testing.assert_allclose(b0[0].numpy(), xs[:2])
+
+
+def test_flops_counts_real_hlo():
+    """paddle.flops via XLA cost analysis: a Linear(8->4) on batch 2 is
+    2*2*8*4 = 128 matmul flops + 2*4 bias adds = 136."""
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    pt.seed(0)
+    m = nn.Linear(8, 4)
+    f = pt.flops(m, [2, 8])
+    assert f == 136
+    m2 = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    f2 = pt.flops(m2, [2, 8], print_detail=True)
+    assert f2 >= 2 * 2 * (8 * 16 + 16 * 4)
+
+
+def test_profiler_events_scheduler_and_program_stats(tmp_path):
+    import time as _time
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu import profiler as prof
+    prof.reset_events()
+    p = prof.Profiler(timer_only=True, scheduler=prof.make_scheduler(
+        skip_first=1, record=2))
+    p.start()
+    for i in range(4):
+        with prof.RecordEvent("work"):
+            _time.sleep(0.002)
+        p.step(num_samples=8)
+    p.stop()
+    s = p.summary()
+    assert "steps=4" in s and "throughput=" in s
+    assert "work" in s and "      4" in s  # event count aggregated
+
+    stats = prof.program_stats(lambda a, b: a @ b,
+                               jnp.ones((8, 16)), jnp.ones((16, 4)))
+    assert stats["flops"] == 1024.0
+
+
+def test_flops_preserves_training_mode():
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    m = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+    m.train()
+    pt.flops(m, [2, 4])
+    assert m.training and m[1].training  # eval() side effect restored
+
+
+def test_profiler_restart_resets():
+    import paddle_tpu.profiler as prof
+    p = prof.Profiler(timer_only=True)
+    p.start()
+    p.step(); p.step()
+    p.stop()
+    p.start()
+    p.step()
+    p.stop()
+    assert "steps=1" in p.summary()

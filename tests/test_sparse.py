@@ -127,3 +127,13 @@ def test_masked_matmul_batched():
     full = np.einsum("bmk,bkn->bmn", x, y)
     np.testing.assert_allclose(out.to_dense().numpy(), eye * full,
                                rtol=1e-4)
+
+
+def test_int_sparse_scalar_keeps_dtype_and_div_zero():
+    idx = np.array([[0], [0]])
+    t = S.sparse_coo_tensor(idx, np.array([4], np.int32), [1, 1])
+    out = S.multiply(t, 2)
+    assert "int" in str(out.dtype)
+    f = S.sparse_coo_tensor(idx, np.array([4.0], np.float32), [1, 1])
+    d = S.divide(f, 0)
+    assert np.isinf(d.values().numpy()).all()  # inf, not ZeroDivisionError
