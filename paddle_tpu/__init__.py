@@ -38,6 +38,7 @@ from . import signal  # noqa: F401
 from . import distribution  # noqa: F401
 from . import quantization  # noqa: F401
 from . import sparse  # noqa: F401
+from . import audio  # noqa: F401
 from . import hapi  # noqa: F401
 from . import callbacks  # noqa: F401
 from .hapi import Model  # noqa: F401
@@ -102,6 +103,7 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
 
 
 def summary(layer, input_size=None):
-    n_params = sum(p.size for p in layer.parameters())
+    import builtins  # module-level `sum` is the tensor op
+    n_params = builtins.sum(int(p.size) for p in layer.parameters())
     print(f"{type(layer).__name__}: {n_params:,} parameters")
     return {"total_params": n_params}

@@ -1,0 +1,9 @@
+"""Audio feature extraction (reference: python/paddle/audio — functional
+window/mel utilities + features.Spectrogram/MelSpectrogram/
+LogMelSpectrogram/MFCC layers).
+
+Built on paddle_tpu.signal's differentiable STFT, so every feature layer
+backprops to the waveform and runs under jit/the fused train step.
+"""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401

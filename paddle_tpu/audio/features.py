@@ -1,0 +1,94 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py).
+
+All layers are nn.Layers over the differentiable STFT, so they compose
+with jit/train_step and backprop to the waveform.
+"""
+from __future__ import annotations
+
+from .. import nn
+from .. import signal as _signal
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(nn.Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer(
+            "window", AF.get_window(window, self.win_length))
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                            window=self.window, center=self.center,
+                            pad_mode=self.pad_mode)
+        mag = (spec.real() ** 2 + spec.imag() ** 2)
+        if self.power == 2.0:
+            return mag
+        return mag ** (self.power / 2.0)
+
+
+class MelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power, center, pad_mode)
+        self.register_buffer("fbank", AF.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm))
+
+    def forward(self, x):
+        spec = self.spectrogram(x)          # [..., freq, time]
+        return self.fbank @ spec            # [n_mels, freq] @ -> mel bands
+
+
+class LogMelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length,
+                                  window, power, center, pad_mode, n_mels,
+                                  f_min, f_max, htk, norm)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self.mel(x), self.ref_value, self.amin,
+                              self.top_db)
+
+
+class MFCC(nn.Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db)
+        self.register_buffer("dct", AF.create_dct(n_mfcc, n_mels))
+
+    def forward(self, x):
+        lm = self.log_mel(x)                 # [..., n_mels, time]
+        dct = self.dct                        # [n_mels, n_mfcc]
+        lm_t = lm.transpose([0, 2, 1]) if len(lm.shape) == 3 \
+            else lm.transpose([1, 0])
+        out = lm_t @ dct                      # [..., time, n_mfcc]
+        return out.transpose([0, 2, 1]) if len(out.shape) == 3 \
+            else out.transpose([1, 0])

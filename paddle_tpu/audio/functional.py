@@ -1,0 +1,129 @@
+"""Audio functional utilities (reference: python/paddle/audio/functional —
+get_window, hz<->mel, mel filterbank, power/amplitude to dB)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["get_window", "hz_to_mel", "mel_to_hz", "compute_fbank_matrix",
+           "power_to_db", "create_dct"]
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """hann/hamming/blackman/bartlett/bohman/... window (periodic when
+    fftbins=True, matching scipy/the reference)."""
+    n = win_length
+    m = n if fftbins else n - 1
+    t = np.arange(n) / max(m, 1)
+    name = window if isinstance(window, str) else window[0]
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * t)
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * t)
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * t)
+             + 0.08 * np.cos(4 * np.pi * t))
+    elif name == "bartlett":
+        w = 1.0 - np.abs(2 * t - 1.0)
+    elif name == "bohman":
+        x = np.abs(2 * t - 1.0)
+        w = (1 - x) * np.cos(np.pi * x) + np.sin(np.pi * x) / np.pi
+    elif name in ("rect", "boxcar", "ones"):
+        w = np.ones(n)
+    elif name == "gaussian":
+        std = window[1] if not isinstance(window, str) else 7.0
+        w = np.exp(-0.5 * ((np.arange(n) - (n - 1) / 2) / std) ** 2)
+    else:
+        raise ValueError(f"unknown window {window!r}")
+    return Tensor(jnp.asarray(w, jnp.float32))
+
+
+def hz_to_mel(freq, htk=False):
+    f = np.asarray(freq, np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:  # slaney
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = np.log(6.4) / 27.0
+        out = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep, out)
+    return float(out) if np.ndim(out) == 0 else out
+
+
+def mel_to_hz(mel, htk=False):
+    m = np.asarray(mel, np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = np.log(6.4) / 27.0
+        out = np.where(m >= min_log_mel,
+                       min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                       out)
+    return float(out) if np.ndim(out) == 0 else out
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """[n_mels, n_fft//2 + 1] triangular mel filterbank."""
+    f_max = f_max if f_max is not None else sr / 2.0
+    n_freqs = n_fft // 2 + 1
+    freqs = np.linspace(0, sr / 2.0, n_freqs)
+    mel_pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                          n_mels + 2)
+    hz_pts = np.asarray([mel_to_hz(m, htk) for m in mel_pts])
+    lower = hz_pts[:-2]
+    center = hz_pts[1:-1]
+    upper = hz_pts[2:]
+    up = (freqs[None, :] - lower[:, None]) / np.maximum(
+        center - lower, 1e-10)[:, None]
+    down = (upper[:, None] - freqs[None, :]) / np.maximum(
+        upper - center, 1e-10)[:, None]
+    fb = np.maximum(0.0, np.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (upper - lower)
+        fb = fb * enorm[:, None]
+    return Tensor(jnp.asarray(fb, jnp.float32))
+
+
+def _power_to_db_impl(x, ref_value=1.0, amin=1e-10, top_db=80.0):
+    log_spec = 10.0 * jnp.log10(jnp.maximum(x, amin))
+    log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(ref_value, amin))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return log_spec
+
+
+from ..ops import dispatch as _ops  # noqa: E402
+
+_ops.register("audio_power_to_db", _power_to_db_impl, amp="deny")
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """10*log10(power/ref) with top_db flooring.  Tape-recorded
+    (differentiable through log-mel losses)."""
+    from ..tensor_api import _t
+    return _ops.call("audio_power_to_db", _t(spect), ref_value=ref_value,
+                     amin=amin, top_db=top_db)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """[n_mels, n_mfcc] DCT-II matrix."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)
+    dct = np.cos(np.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / np.sqrt(2)
+        dct *= np.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct, jnp.float32))

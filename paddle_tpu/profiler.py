@@ -97,6 +97,8 @@ class Profiler:
         self._t0 = time.perf_counter()
 
     def step(self, num_samples=None):
+        if not self._started:
+            return   # step() outside start()/stop() must not start traces
         t = time.perf_counter()
         if self._t0 is not None:
             self._step_times.append(t - self._t0)

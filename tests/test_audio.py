@@ -1,0 +1,88 @@
+"""paddle.audio tests (windows, mel scale, feature layers)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.audio import functional as AF
+from paddle_tpu.audio.features import (Spectrogram, MelSpectrogram,
+                                       LogMelSpectrogram, MFCC)
+
+
+def test_windows_match_numpy():
+    np.testing.assert_allclose(
+        AF.get_window("hann", 16, fftbins=False).numpy(),
+        np.hanning(16), atol=1e-6)
+    np.testing.assert_allclose(
+        AF.get_window("hamming", 16, fftbins=False).numpy(),
+        np.hamming(16), atol=1e-6)
+    np.testing.assert_allclose(
+        AF.get_window("blackman", 16, fftbins=False).numpy(),
+        np.blackman(16), atol=1e-6)
+
+
+def test_mel_scale_roundtrip():
+    for htk in (False, True):
+        for hz in (60.0, 440.0, 4000.0):
+            mel = AF.hz_to_mel(hz, htk=htk)
+            np.testing.assert_allclose(AF.mel_to_hz(mel, htk=htk), hz,
+                                       rtol=1e-6)
+
+
+def test_fbank_shape_and_partition():
+    fb = AF.compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all()
+    # every filter has some support
+    assert (fb.sum(1) > 0).all()
+
+
+def test_spectrogram_shapes_and_parseval():
+    sr = 16000
+    t = np.linspace(0, 1, sr, endpoint=False)
+    x = np.sin(2 * np.pi * 440 * t).astype(np.float32)
+    spec = Spectrogram(n_fft=512, hop_length=160)(pt.to_tensor(x[None]))
+    assert tuple(spec.shape) == (1, 257, sr // 160 + 1)
+    # peak frequency bin ~ 440 Hz
+    avg = spec.numpy()[0].mean(-1)
+    peak_hz = np.argmax(avg) * sr / 512
+    assert abs(peak_hz - 440) < 40
+
+
+def test_mel_and_logmel_and_mfcc_shapes():
+    x = pt.randn([2, 8000])
+    mel = MelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+    assert tuple(mel.shape)[:2] == (2, 40)
+    logmel = LogMelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+    assert tuple(logmel.shape) == tuple(mel.shape)
+    mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)(x)
+    assert tuple(mfcc.shape)[:2] == (2, 13)
+
+
+def test_power_to_db_flooring():
+    x = pt.to_tensor(np.array([1.0, 0.1, 1e-12], np.float32))
+    db = AF.power_to_db(x, top_db=30.0).numpy()
+    np.testing.assert_allclose(db[0], 0.0, atol=1e-5)
+    np.testing.assert_allclose(db[1], -10.0, atol=1e-4)
+    assert db[2] == pytest.approx(-30.0)   # floored by top_db
+
+
+def test_mfcc_backprops_to_waveform():
+    x = pt.randn([1, 4096]); x.stop_gradient = False
+    out = MFCC(sr=16000, n_mfcc=8, n_fft=256, n_mels=24)(x)
+    out.sum().backward()
+    assert x.grad is not None
+    assert np.isfinite(x.grad.numpy()).all()
+
+
+def test_top_level_summary_works():
+    import paddle_tpu.nn as nn
+    info = pt.summary(nn.Linear(3, 4))
+    assert info["total_params"] == 16
+
+
+def test_profiler_step_after_stop_is_inert():
+    from paddle_tpu import profiler as prof
+    p = prof.Profiler(timer_only=True)
+    p.start(); p.step(); p.stop()
+    p.step()   # must not restart anything
+    assert "steps=1" in p.summary()
