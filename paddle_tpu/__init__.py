@@ -42,6 +42,8 @@ from . import audio  # noqa: F401
 from . import hapi  # noqa: F401
 from . import callbacks  # noqa: F401
 from .hapi import Model  # noqa: F401
+from .framework.param_attr import ParamAttr  # noqa: F401
+from . import regularizer  # noqa: F401
 from .framework.random import get_rng_state, set_rng_state  # noqa: F401
 from .framework import checkpoint  # noqa: F401
 from .framework.checkpoint import save_state, load_state  # noqa: F401

@@ -512,13 +512,23 @@ class DistributedTrainStep:
             fleet_names = self._fleet_param_names
             fleet_scales = self._fleet_lr_scales
             fleet_wds = self._fleet_wd_overrides
+            outer_named2, blocks2, leaf_names2, _ = self._pp_split()
+            b02 = dict(blocks2[0].named_parameters())
+            flat_ps = [p for _, p in outer_named2] + \
+                [b02[ln] for ln in leaf_names2]
         else:
             # key ordering was fixed in _place_state (single source for
             # the checkpoint key scheme) — only derive the group scales
             fleet_names = self._fleet_param_names
-            params_ = [p for _, p in model.named_parameters()]
-            fleet_scales = [gmap.get(id(p), (1.0, None))[0] for p in params_]
-            fleet_wds = [gmap.get(id(p), (1.0, None))[1] for p in params_]
+            flat_ps = [p for _, p in model.named_parameters()]
+            fleet_scales = [gmap.get(id(p), (1.0, None))[0]
+                            for p in flat_ps]
+            fleet_wds = [gmap.get(id(p), (1.0, None))[1] for p in flat_ps]
+        # frozen params keep their values; need_clip=False skips clipping
+        fleet_frozen = [p.stop_gradient for p in flat_ps]
+        fleet_clip = [not fz and (getattr(p, "optimize_attr", None)
+                                  or {}).get("need_clip", True)
+                      for fz, p in zip(fleet_frozen, flat_ps)]
 
         def step_fn(param_tree, buffer_arrays, opt_state, lr, step, rng,
                     batch):
@@ -527,9 +537,12 @@ class DistributedTrainStep:
                     param_tree, buffer_arrays, rng, batch)
             flat_g = flatten(grads)
             flat_p = flatten(param_tree)
+            flat_g = [None if fz else g
+                      for g, fz in zip(flat_g, fleet_frozen)]
             finite = _dbg.finite_flags(loss, flat_g) if check else None
             if optimizer._grad_clip is not None:
-                flat_g = optimizer._clip_grad_arrays(flat_g)
+                flat_g = optimizer._clip_grad_arrays(flat_g,
+                                                     need_clip=fleet_clip)
             new_flat, new_opt = optimizer.update(
                 flat_g, flat_p, opt_state, lr, step,
                 param_names=fleet_names, lr_scales=fleet_scales,

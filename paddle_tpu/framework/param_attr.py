@@ -1,0 +1,37 @@
+"""paddle.ParamAttr (reference: python/paddle/base/param_attr.py).
+
+Carries per-parameter configuration into Layer.create_parameter: name,
+initializer, a per-param learning-rate coefficient (folded into the
+optimizer's lr scales), trainable, and an L2 regularizer coefficient.
+"""
+from __future__ import annotations
+
+__all__ = ["ParamAttr"]
+
+
+class ParamAttr:
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = float(learning_rate)
+        self.regularizer = regularizer
+        self.trainable = bool(trainable)
+        self.need_clip = need_clip
+
+    def apply_to(self, tensor):
+        """Stamp this attr's runtime fields onto a freshly created param."""
+        if self.name:
+            tensor.name = self.name
+        tensor.stop_gradient = not self.trainable
+        oa = {}
+        if self.learning_rate != 1.0:
+            oa["learning_rate"] = self.learning_rate
+        if self.regularizer is not None:
+            oa["regularizer"] = self.regularizer
+        if self.need_clip is False:
+            oa["need_clip"] = False
+        if oa:
+            tensor.optimize_attr = oa
+        return tensor

@@ -52,15 +52,23 @@ class TrainStep:
         p_names = [n for n, _ in named]
         p_scales = [gmap.get(id(p), (1.0, None))[0] for _, p in named]
         p_wds = [gmap.get(id(p), (1.0, None))[1] for _, p in named]
+        # frozen (stop_gradient / ParamAttr(trainable=False)) params stay
+        # registered in named_parameters but must not be updated
+        p_frozen = [p.stop_gradient for _, p in named]
+        p_clip = [not fz and (getattr(p, "optimize_attr", None)
+                              or {}).get("need_clip", True)
+                  for fz, (_, p) in zip(p_frozen, named)]
 
         def step_fn(param_arrays, buffer_arrays, opt_state, lr, step, rng,
                     batch_arrays):
             (loss, new_buffers), grads = jax.value_and_grad(
                 compute_loss, has_aux=True)(
                     param_arrays, buffer_arrays, rng, batch_arrays)
+            grads = [None if fz else g for g, fz in zip(grads, p_frozen)]
             finite = _dbg.finite_flags(loss, grads) if check else None
             if optimizer._grad_clip is not None:
-                grads = optimizer._clip_grad_arrays(grads)
+                grads = optimizer._clip_grad_arrays(grads,
+                                                    need_clip=p_clip)
             new_params, new_opt_state = optimizer.update(
                 grads, param_arrays, opt_state, lr, step,
                 param_names=p_names, lr_scales=p_scales, wd_overrides=p_wds)

@@ -26,10 +26,11 @@ class _ConvNd(Layer):
         self.data_format = data_format
         w_shape = [out_channels, in_channels // groups, *self.kernel_size]
         self.weight = self.create_parameter(
-            w_shape, default_initializer=_attr_init(weight_attr)
+            w_shape, attr=weight_attr,
+            default_initializer=_attr_init(weight_attr)
             or I.KaimingUniform())
         self.bias = None if bias_attr is False else self.create_parameter(
-            [out_channels], is_bias=True,
+            [out_channels], attr=bias_attr, is_bias=True,
             default_initializer=_attr_init(bias_attr) or I.Constant(0.0))
 
     def extra_repr(self):
@@ -85,10 +86,10 @@ class Conv2DTranspose(Layer):
         k = _ntuple(kernel_size, 2)
         # reference layout: [in_channels, out_channels // groups, H, W]
         self.weight = self.create_parameter(
-            [in_channels, out_channels // groups, *k],
+            [in_channels, out_channels // groups, *k], attr=weight_attr,
             default_initializer=_attr_init(weight_attr) or I.KaimingUniform())
         self.bias = None if bias_attr is False else self.create_parameter(
-            [out_channels], is_bias=True,
+            [out_channels], attr=bias_attr, is_bias=True,
             default_initializer=_attr_init(bias_attr) or I.Constant(0.0))
 
     def forward(self, x):

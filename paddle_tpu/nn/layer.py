@@ -33,7 +33,12 @@ class Layer:
         params = self.__dict__.get("_parameters")
         layers = self.__dict__.get("_sub_layers")
         buffers = self.__dict__.get("_buffers")
-        if isinstance(value, Tensor) and not value.stop_gradient:
+        if isinstance(value, Tensor) and (
+                not value.stop_gradient or getattr(value, "persistable",
+                                                   False)):
+            # persistable covers frozen params (ParamAttr(trainable=False)):
+            # they must stay in _parameters/state_dict even though they
+            # take no gradient
             if params is None:
                 raise RuntimeError("call Layer.__init__ before assigning params")
             params[name] = value
@@ -86,6 +91,8 @@ class Layer:
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
         init(t)
+        if attr is not None and hasattr(attr, "apply_to"):
+            attr.apply_to(t)   # ParamAttr: name/trainable/lr coefficient
         return t
 
     def add_parameter(self, name, parameter):

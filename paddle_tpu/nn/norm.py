@@ -19,10 +19,10 @@ class LayerNorm(Layer):
         self.normalized_shape = list(normalized_shape)
         self.epsilon = epsilon
         self.weight = None if weight_attr is False else self.create_parameter(
-            self.normalized_shape,
+            self.normalized_shape, attr=weight_attr,
             default_initializer=_attr_init(weight_attr) or I.Constant(1.0))
         self.bias = None if bias_attr is False else self.create_parameter(
-            self.normalized_shape, is_bias=True,
+            self.normalized_shape, attr=bias_attr, is_bias=True,
             default_initializer=_attr_init(bias_attr) or I.Constant(0.0))
 
     def forward(self, x):
@@ -38,7 +38,7 @@ class RMSNorm(Layer):
         super().__init__()
         self.epsilon = epsilon
         self.weight = self.create_parameter(
-            [hidden_size],
+            [hidden_size], attr=weight_attr,
             default_initializer=_attr_init(weight_attr) or I.Constant(1.0))
 
     def forward(self, x):
@@ -52,10 +52,11 @@ class GroupNorm(Layer):
         self.num_groups = num_groups
         self.epsilon = epsilon
         self.weight = None if weight_attr is False else self.create_parameter(
-            [num_channels], default_initializer=I.Constant(1.0))
+            [num_channels], attr=weight_attr,
+            default_initializer=_attr_init(weight_attr) or I.Constant(1.0))
         self.bias = None if bias_attr is False else self.create_parameter(
-            [num_channels], is_bias=True,
-            default_initializer=I.Constant(0.0))
+            [num_channels], attr=bias_attr, is_bias=True,
+            default_initializer=_attr_init(bias_attr) or I.Constant(0.0))
 
     def forward(self, x):
         return F.group_norm(x, self.num_groups, self.weight, self.bias,
@@ -73,10 +74,10 @@ class _BatchNormBase(Layer):
         self.data_format = data_format
         self.use_global_stats = use_global_stats
         self.weight = None if weight_attr is False else self.create_parameter(
-            [num_features],
+            [num_features], attr=weight_attr,
             default_initializer=_attr_init(weight_attr) or I.Constant(1.0))
         self.bias = None if bias_attr is False else self.create_parameter(
-            [num_features], is_bias=True,
+            [num_features], attr=bias_attr, is_bias=True,
             default_initializer=_attr_init(bias_attr) or I.Constant(0.0))
         self.register_buffer("_mean",
                              Tensor(jnp.zeros(num_features, jnp.float32)))
@@ -140,10 +141,11 @@ class InstanceNorm2D(Layer):
         super().__init__()
         self.epsilon = epsilon
         self.weight = None if weight_attr is False else self.create_parameter(
-            [num_features], default_initializer=I.Constant(1.0))
+            [num_features], attr=weight_attr,
+            default_initializer=_attr_init(weight_attr) or I.Constant(1.0))
         self.bias = None if bias_attr is False else self.create_parameter(
-            [num_features], is_bias=True,
-            default_initializer=I.Constant(0.0))
+            [num_features], attr=bias_attr, is_bias=True,
+            default_initializer=_attr_init(bias_attr) or I.Constant(0.0))
 
     def forward(self, x):
         # instance norm == group norm with one group per channel

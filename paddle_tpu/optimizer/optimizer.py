@@ -46,6 +46,23 @@ class Optimizer:
             self._parameters = parameters
             self._lr_scales = [1.0] * len(parameters)
             self._wd_overrides = [None] * len(parameters)
+        # fold per-parameter ParamAttr fields into the group bookkeeping
+        # (reference: param.optimize_attr / param.regularizer):
+        # learning_rate multiplies the group coefficient; a per-param
+        # regularizer overrides the global weight_decay; need_clip=False
+        # exempts the param from gradient clipping
+        def _oa(p):
+            return getattr(p, "optimize_attr", None) or {}
+
+        self._lr_scales = [
+            s * float(_oa(p).get("learning_rate", 1.0))
+            for p, s in zip(self._parameters, self._lr_scales)]
+        self._wd_overrides = [
+            _decay_value(_oa(p)["regularizer"])
+            if wd is None and "regularizer" in _oa(p) else wd
+            for p, wd in zip(self._parameters, self._wd_overrides)]
+        self._need_clip = [bool(_oa(p).get("need_clip", True))
+                           for p in self._parameters]
         self._group_by_id = {
             id(p): (s, w) for p, s, w in zip(
                 self._parameters, self._lr_scales, self._wd_overrides)}
@@ -156,12 +173,17 @@ class Optimizer:
     _couple_decay = True
 
     # --------------------------------------------------------------- eager
-    def _clip_grad_arrays(self, grads):
+    def _clip_grad_arrays(self, grads, need_clip=None):
         if self._grad_clip is None:
             return grads
-        present = [g for g in grads if g is not None]
+        mask = need_clip if need_clip is not None else \
+            getattr(self, "_need_clip", None)
+        if mask is None or len(mask) != len(grads):
+            mask = [True] * len(grads)
+        present = [g for g, m in zip(grads, mask) if m and g is not None]
         clipped = iter(self._grad_clip._clip_arrays(present))
-        return [next(clipped) if g is not None else None for g in grads]
+        return [next(clipped) if (g is not None and m) else g
+                for g, m in zip(grads, mask)]
 
     def step(self):
         params = [p._array for p in self._parameters]
@@ -237,6 +259,10 @@ class Optimizer:
 def _decay_value(weight_decay):
     if weight_decay is None:
         return 0.0
+    if isinstance(weight_decay, L1Decay):
+        raise NotImplementedError(
+            "L1Decay regularization is not implemented (the optimizers "
+            "apply L2-style decay); use L2Decay")
     coeff = getattr(weight_decay, "_coeff", None)  # L2Decay object
     return float(coeff if coeff is not None else weight_decay)
 

@@ -1,0 +1,9 @@
+"""paddle.regularizer (reference: python/paddle/regularizer.py).
+
+Single class identities shared with paddle_tpu.optimizer: L2Decay is the
+decoupled/coupled decay coefficient holder the optimizers consume;
+L1Decay raises on use (not implemented in the update rules).
+"""
+from .optimizer.optimizer import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

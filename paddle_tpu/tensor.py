@@ -37,7 +37,8 @@ def _coerce(data, dtype=None):
 
 class Tensor:
     __slots__ = ("_array", "stop_gradient", "grad", "_node", "_out_index",
-                 "_retain_grads", "name", "persistable", "pspec", "__weakref__")
+                 "_retain_grads", "name", "persistable", "pspec",
+                 "optimize_attr", "__weakref__")
 
     def __init__(self, data=None, dtype=None, place=None, stop_gradient=True,
                  name=None):
@@ -50,6 +51,7 @@ class Tensor:
         self.name = name
         self.persistable = False
         self.pspec = None  # PartitionSpec annotation for distributed runs
+        self.optimize_attr = None  # ParamAttr per-param lr coefficient etc.
 
     # ------------------------------------------------------------- wrapping
     @classmethod
@@ -64,6 +66,7 @@ class Tensor:
         t.name = name
         t.persistable = False
         t.pspec = None
+        t.optimize_attr = None
         return t
 
     # ----------------------------------------------------------- properties

@@ -337,3 +337,125 @@ def test_profiler_restart_resets():
     p.step()
     p.stop()
     assert "steps=1" in p.summary()
+
+
+def test_param_attr_initializer_trainable_and_lr():
+    import numpy as np
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    pt.seed(0)
+    lin = nn.Linear(
+        4, 4,
+        weight_attr=pt.ParamAttr(
+            name="my_w", initializer=nn.initializer.Constant(0.5),
+            learning_rate=0.1),
+        bias_attr=pt.ParamAttr(trainable=False))
+    np.testing.assert_allclose(lin.weight.numpy(), 0.5)
+    assert lin.weight.name == "my_w"
+    assert lin.bias.stop_gradient  # frozen by trainable=False
+    assert lin.weight.optimize_attr == {"learning_rate": 0.1}
+
+    # the per-param lr coefficient reaches the optimizer scales
+    opt = pt.optimizer.SGD(learning_rate=1.0,
+                           parameters=[lin.weight])
+    x = pt.ones([2, 4]); y = pt.zeros([2, 4])
+    import paddle_tpu.nn.functional as F
+    loss = F.mse_loss(lin(x), y)
+    loss.backward()
+    g = lin.weight.grad.numpy().copy()
+    w0 = lin.weight.numpy().copy()
+    opt.step()
+    np.testing.assert_allclose(lin.weight.numpy(), w0 - 0.1 * g, rtol=1e-5)
+
+
+def test_param_attr_review_regressions():
+    """Frozen params stay in state_dict; conv/norm honor ParamAttr;
+    per-param regularizer feeds decay; need_clip exempts from clipping;
+    L1Decay raises loudly."""
+    import numpy as np
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    pt.seed(0)
+    # frozen param remains a registered parameter
+    lin = nn.Linear(4, 4, bias_attr=pt.ParamAttr(trainable=False))
+    assert "bias" in dict(lin.named_parameters())
+    assert "bias" in lin.state_dict()
+
+    # conv + norm honor trainable/lr
+    conv = nn.Conv2D(3, 8, 3, weight_attr=pt.ParamAttr(
+        learning_rate=0.5, trainable=False))
+    assert conv.weight.stop_gradient
+    assert conv.weight.optimize_attr["learning_rate"] == 0.5
+    ln = nn.LayerNorm(8, weight_attr=pt.ParamAttr(trainable=False))
+    assert ln.weight.stop_gradient
+    bn = nn.BatchNorm2D(4, weight_attr=pt.ParamAttr(trainable=False))
+    assert bn.weight.stop_gradient
+
+    # per-param regularizer overrides global decay
+    w = nn.Linear(4, 4, weight_attr=pt.ParamAttr(
+        regularizer=pt.regularizer.L2Decay(0.7)))
+    opt = pt.optimizer.AdamW(learning_rate=0.1, weight_decay=0.0,
+                             parameters=w.parameters())
+    assert 0.7 in opt._wd_overrides
+
+    # need_clip=False exempts from clipping
+    a = pt.parameter(np.ones((2,), np.float32))
+    b = pt.parameter(np.ones((2,), np.float32))
+    b.optimize_attr = {"need_clip": False}
+    opt2 = pt.optimizer.SGD(learning_rate=1.0, parameters=[a, b],
+                            grad_clip=pt.nn.ClipGradByGlobalNorm(0.1))
+    import jax.numpy as jnp
+    g = [jnp.ones((2,)) * 10, jnp.ones((2,)) * 10]
+    out = opt2._clip_grad_arrays(g)
+    assert float(jnp.abs(out[0]).max()) < 1.0   # clipped
+    assert float(jnp.abs(out[1]).max()) == 10.0  # exempt
+
+    with pytest.raises(NotImplementedError):
+        pt.optimizer.SGD(learning_rate=0.1,
+                         weight_decay=pt.regularizer.L1Decay(0.1),
+                         parameters=[a])
+
+
+def test_frozen_param_not_updated_by_fused_and_fleet_steps():
+    """stop_gradient params are registered but must stay bit-exact through
+    the fused TrainStep AND the fleet engine."""
+    import numpy as np
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed import fleet, mesh as mesh_mod
+
+    pt.seed(0)
+    m = nn.Sequential(
+        nn.Linear(8, 16, weight_attr=pt.ParamAttr(trainable=False)),
+        nn.Tanh(), nn.Linear(16, 8))
+    frozen0 = m[0].weight.numpy().copy()
+    assert "0.weight" in dict(m.named_parameters())
+    opt = pt.optimizer.Adam(learning_rate=0.05, parameters=m.parameters())
+    step = pt.jit.train_step(m, lambda mm, a, b: F.mse_loss(mm(a), b), opt)
+    x = pt.randn([8, 8]); y = pt.randn([8, 8])
+    l0 = float(step(x, y)); l1 = float(step(x, y))
+    assert l1 < l0
+    np.testing.assert_array_equal(m[0].weight.numpy(), frozen0)
+
+    prev = dict(mesh_mod._state)
+    try:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 1,
+                                   "pp_degree": 1, "sharding_degree": 4,
+                                   "sharding_stage": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        pt.seed(1)
+        m2 = nn.Sequential(
+            nn.Linear(8, 16, weight_attr=pt.ParamAttr(trainable=False)),
+            nn.Tanh(), nn.Linear(16, 8))
+        frozen2 = m2[0].weight.numpy().copy()
+        opt2 = pt.optimizer.Adam(learning_rate=0.05,
+                                 parameters=m2.parameters())
+        fstep = fleet.build_train_step(
+            m2, lambda mm, a, b: F.mse_loss(mm(a), b), opt2)
+        f0 = float(fstep(x, y)); f1 = float(fstep(x, y))
+        assert f1 < f0
+        np.testing.assert_array_equal(m2[0].weight.numpy(), frozen2)
+    finally:
+        mesh_mod._state.update(prev)
