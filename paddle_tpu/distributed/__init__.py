@@ -13,7 +13,7 @@ from . import mesh  # noqa: F401
 from .mesh import build_mesh, get_mesh, set_mesh  # noqa: F401
 from .collective import (  # noqa: F401
     ReduceOp, all_reduce, all_gather, reduce_scatter, broadcast, scatter,
-    barrier, ppermute, stream_synchronize,
+    alltoall, alltoall_single, barrier, ppermute, stream_synchronize,
 )
 from .recompute import recompute  # noqa: F401
 from .parallel_layers import (  # noqa: F401

@@ -175,6 +175,61 @@ def scatter(tensor, tensor_list=None, src=0, group=None):
     return tensor
 
 
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """Each rank i sends in_tensor_list[j] to rank j (reference:
+    paddle.distributed.alltoall over NCCL — the expert-parallel transport).
+    Inside shard_map this is ONE lax.all_to_all on ICI; note the GSPMD MoE
+    path (incubate.nn.MoELayer) never calls this explicitly — XLA inserts
+    the equivalent collective from the dispatch einsum shardings."""
+    axis = _axis(group)
+    arrs = [t._array if isinstance(t, Tensor) else jnp.asarray(t)
+            for t in in_tensor_list]
+    stacked = jnp.stack(arrs)
+    try:
+        out = lax.all_to_all(stacked, axis, 0, 0, tiled=False)
+        outs = [out[i] for i in range(out.shape[0])]
+    except NameError:
+        if jax.process_count() > 1:
+            n_local = jax.local_device_count()
+            g = _mp_collective(stacked, "stack")[::n_local]  # [W, W, ...]
+            r = jax.process_index()
+            outs = [g[p, r] for p in range(g.shape[0])]
+        else:
+            outs = arrs  # world per process == 1: identity
+    wrapped = [Tensor._from_array(a) for a in outs]
+    if out_tensor_list is not None:
+        if len(out_tensor_list):
+            if len(out_tensor_list) != len(wrapped):
+                raise ValueError(
+                    f"out_tensor_list has {len(out_tensor_list)} entries, "
+                    f"alltoall produced {len(wrapped)}")
+            for dst, src in zip(out_tensor_list, wrapped):
+                dst._array = src._array
+        else:
+            out_tensor_list.extend(wrapped)
+        return out_tensor_list
+    return wrapped
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """alltoall on one tensor split evenly along dim 0."""
+    if in_split_sizes is not None or out_split_sizes is not None:
+        raise NotImplementedError(
+            "uneven alltoall_single splits are not supported (XLA "
+            "all_to_all is tiled/even); pad to equal chunks")
+    axis = _axis(group)
+    arr = in_tensor._array if isinstance(in_tensor, Tensor) else in_tensor
+    try:
+        out = lax.all_to_all(arr, axis, 0, 0, tiled=True)
+    except NameError:
+        out = arr  # single-controller eager: world per process == 1
+    if isinstance(out_tensor, Tensor):
+        out_tensor._array = out
+        return out_tensor
+    return Tensor._from_array(out)
+
+
 def send(tensor, dst=0, group=None):
     raise NotImplementedError(
         "point-to-point send/recv maps to lax.ppermute inside shard_map; "

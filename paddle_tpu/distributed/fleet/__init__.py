@@ -19,7 +19,7 @@ class DistributedStrategy:
         self.hybrid_configs = {
             "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
             "sharding_degree": 1, "sharding_stage": 0,
-            "sep_degree": 1,
+            "sep_degree": 1, "ep_degree": 1,
         }
         self.amp = False
         self.amp_configs = {}
@@ -42,7 +42,8 @@ class _Fleet:
         hc = self._strategy.hybrid_configs
         mesh_mod.build_mesh(dp=int(hc.get("dp_degree", 1) or 1),
                             pp=int(hc.get("pp_degree", 1) or 1),
-                            mp=int(hc.get("mp_degree", 1) or 1))
+                            mp=int(hc.get("mp_degree", 1) or 1),
+                            ep=int(hc.get("ep_degree", 1) or 1))
         self._initialized = True
         return self
 
@@ -104,6 +105,12 @@ class HybridCommunicateGroup:
 
     def get_pipe_parallel_group(self):
         return _AxisGroup("pp")
+
+    def get_expert_parallel_world_size(self):
+        return mesh_mod.degree("ep")
+
+    def get_expert_parallel_group(self):
+        return _AxisGroup("ep")
 
 
 class _AxisGroup:

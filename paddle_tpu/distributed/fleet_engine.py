@@ -108,6 +108,14 @@ class DistributedTrainStep:
             raise ValueError(
                 "pp_degree > 1 requires the model to implement "
                 "pipeline_decompose() (blocks/pre/post stage plan)")
+        if self.use_pp:
+            from ..incubate.nn.moe import MoELayer
+            if any(isinstance(l, MoELayer)
+                   for l in model.sublayers(include_self=True)):
+                raise NotImplementedError(
+                    "pp_degree > 1 with MoE blocks is not supported: the "
+                    "router aux losses cannot escape the pipelined scan — "
+                    "use dp x ep x mp for expert models")
         pc = getattr(strategy, "pipeline_configs", None) or {}
         self.n_microbatches = int(
             pc.get("accumulate_steps") if int(pc.get(

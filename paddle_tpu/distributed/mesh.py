@@ -17,16 +17,22 @@ _state = {"mesh": None, "degrees": None}
 AXES = ("dp", "pp", "mp")
 
 
-def build_mesh(dp=1, pp=1, mp=1, devices=None):
+def build_mesh(dp=1, pp=1, mp=1, ep=1, devices=None):
+    """ep>1 appends an expert-parallel axis (MoE expert sharding rides it);
+    it is left off the mesh otherwise so non-MoE meshes keep the classic
+    3-axis ("dp","pp","mp") topology."""
     devices = devices if devices is not None else jax.devices()
-    n = dp * pp * mp
+    n = dp * pp * mp * ep
     if n > len(devices):
         raise ValueError(
-            f"hybrid degrees dp{dp}*pp{pp}*mp{mp}={n} > {len(devices)} devices")
-    devs = np.asarray(devices[:n]).reshape(dp, pp, mp)
-    mesh = Mesh(devs, AXES)
+            f"hybrid degrees dp{dp}*pp{pp}*mp{mp}*ep{ep}={n} > "
+            f"{len(devices)} devices")
+    shape = (dp, pp, mp) + ((ep,) if ep > 1 else ())
+    axes = AXES + (("ep",) if ep > 1 else ())
+    devs = np.asarray(devices[:n]).reshape(shape)
+    mesh = Mesh(devs, axes)
     _state["mesh"] = mesh
-    _state["degrees"] = {"dp": dp, "pp": pp, "mp": mp}
+    _state["degrees"] = {"dp": dp, "pp": pp, "mp": mp, "ep": ep}
     return mesh
 
 

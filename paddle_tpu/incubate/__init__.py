@@ -1,0 +1,8 @@
+"""paddle.incubate analog — experimental surface (MoE, fused layers).
+
+Reference analog: python/paddle/incubate/* ; the expert-parallel MoE stack
+lives here the way the reference keeps it under
+paddle.incubate.distributed.models.moe.
+"""
+from . import nn  # noqa: F401
+from .nn.moe import MoELayer, moe_aux_loss  # noqa: F401

@@ -1,0 +1,5 @@
+"""paddle.incubate.nn analog: MoE + fused transformer layers."""
+from .moe import MoELayer, moe_ffn, moe_aux_loss  # noqa: F401
+from .fused_transformer import (  # noqa: F401
+    FusedMultiHeadAttention, FusedFeedForward,
+)

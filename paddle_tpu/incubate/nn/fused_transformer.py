@@ -1,0 +1,118 @@
+"""Fused transformer layers (reference analog: python/paddle/incubate/nn/
+layer/fused_transformer.py — FusedMultiHeadAttention / FusedFeedForward,
+which the reference implements as single fused CUDA kernels).
+
+TPU-native: "fused" here means ONE dispatch region that XLA fuses — a single
+packed qkv matmul, sdpa (flash-attention Pallas override when registered),
+and the residual+dropout+layernorm epilogue expressed so XLA folds it into
+the surrounding matmuls.  Same layer semantics, compiler-made fusion.
+"""
+from __future__ import annotations
+
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer import Layer
+
+
+class FusedMultiHeadAttention(Layer):
+    """Pre/post-LN multi-head self-attention with packed qkv weights.
+
+    Matches the reference layer's contract: input [B, S, D], residual +
+    dropout + layer_norm applied inside (normalize_before selects pre-LN).
+    """
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, linear_weight_attr=None,
+                 epsilon=1e-5, name=None):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError("num_heads must evenly divide embed_dim")
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        init = I.XavierUniform()
+        self.qkv_weight = self.create_parameter(
+            [embed_dim, 3 * embed_dim], default_initializer=init)
+        self.qkv_bias = self.create_parameter(
+            [3 * embed_dim], is_bias=True,
+            default_initializer=I.Constant(0.0))
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], default_initializer=init)
+        self.linear_bias = self.create_parameter(
+            [embed_dim], is_bias=True, default_initializer=I.Constant(0.0))
+        self.ln_scale = self.create_parameter(
+            [embed_dim], default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [embed_dim], is_bias=True, default_initializer=I.Constant(0.0))
+        self.epsilon = epsilon
+
+    def forward(self, x, attn_mask=None):
+        residual = x
+        if self.normalize_before:
+            x = F.layer_norm(x, [x.shape[-1]], self.ln_scale, self.ln_bias,
+                             self.epsilon)
+        b, s, d = x.shape
+        qkv = F.linear(x, self.qkv_weight, self.qkv_bias)
+        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv.unbind(axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate, training=self.training)
+        out = F.linear(out.reshape([b, s, d]), self.linear_weight,
+                       self.linear_bias)
+        out = residual + F.dropout(out, self.dropout_rate,
+                                   training=self.training)
+        if not self.normalize_before:
+            out = F.layer_norm(out, [d], self.ln_scale, self.ln_bias,
+                               self.epsilon)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """Pre/post-LN 2-layer FFN with residual + dropout, one fused region."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear2_weight_attr=None, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = dropout_rate if act_dropout_rate is None \
+            else act_dropout_rate
+        self.activation = activation
+        init = I.XavierUniform()
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], default_initializer=init)
+        self.linear1_bias = self.create_parameter(
+            [dim_feedforward], is_bias=True,
+            default_initializer=I.Constant(0.0))
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], default_initializer=init)
+        self.linear2_bias = self.create_parameter(
+            [d_model], is_bias=True, default_initializer=I.Constant(0.0))
+        self.ln_scale = self.create_parameter(
+            [d_model], default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [d_model], is_bias=True, default_initializer=I.Constant(0.0))
+        self.epsilon = epsilon
+
+    def forward(self, x):
+        residual = x
+        if self.normalize_before:
+            x = F.layer_norm(x, [x.shape[-1]], self.ln_scale, self.ln_bias,
+                             self.epsilon)
+        act = getattr(F, self.activation)
+        h = act(F.linear(x, self.linear1_weight, self.linear1_bias))
+        h = F.dropout(h, self.act_dropout_rate, training=self.training)
+        h = F.linear(h, self.linear2_weight, self.linear2_bias)
+        out = residual + F.dropout(h, self.dropout_rate,
+                                   training=self.training)
+        if not self.normalize_before:
+            out = F.layer_norm(out, [out.shape[-1]], self.ln_scale,
+                               self.ln_bias, self.epsilon)
+        return out

@@ -1,0 +1,234 @@
+"""Mixture-of-Experts with expert parallelism (reference analog:
+python/paddle/incubate/distributed/models/moe/moe_layer.py — MoELayer with
+gshard/switch gates over an expert-parallel process group, dispatching via
+NCCL all-to-all).
+
+TPU-native design (GShard / Switch-Transformer recipe): the experts' weights
+are STACKED on a leading expert axis ([E, d, f]) and sharded over the "ep"
+mesh axis via PartitionSpec annotations; token dispatch/combine are dense
+one-hot einsums with a static per-expert capacity, so the whole layer is a
+fixed-shape XLA program — GSPMD turns the [tokens, ...] <-> [experts, ...]
+einsums into the all-to-alls the reference issues by hand, and overlaps them
+with the expert matmuls on ICI.  No dynamic shapes, no per-expert Python
+loops: everything lands on the MXU.
+
+Within each expert, the hidden dimension may additionally be sharded over
+"mp" (expert tensor parallelism), composing ep x mp the way the reference
+composes its expert group with Megatron mp groups.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd import engine
+from ...distributed import mesh as mesh_mod
+from ...nn import initializer as I
+from ...nn.layer import Layer
+from ...tensor import Tensor
+
+
+try:
+    from jax.core import trace_state_clean as _trace_state_clean
+except ImportError:  # not re-exported in every jax release
+    from jax._src.core import trace_state_clean as _trace_state_clean
+
+
+def _maybe_shard(a, *spec):
+    """with_sharding_constraint if the mesh carries the referenced axes."""
+    if not mesh_mod.has_mesh():
+        return a
+    axes = set(mesh_mod.get_mesh().axis_names)
+    spec = tuple(s if (s in axes and mesh_mod.degree(s) > 1) else None
+                 for s in spec)
+    if all(s is None for s in spec):
+        return a
+    try:
+        return jax.lax.with_sharding_constraint(a, mesh_mod.sharding(*spec))
+    except Exception:  # inside shard_map / no-mesh trace: annotation-free
+        return a
+
+
+def _activation(name):
+    return {"gelu": lambda h: jax.nn.gelu(h, approximate=True),
+            "relu": jax.nn.relu,
+            "silu": jax.nn.silu,
+            "swish": jax.nn.silu}[name]
+
+
+def moe_ffn(x, wg, w1, b1, w2, b2, *, top_k, capacity, act="gelu",
+            z_loss_weight=0.0):
+    """Pure-jax MoE feed-forward on flattened tokens.
+
+    x [N, d]; wg [d, E]; w1 [E, d, f]; b1 [E, f]; w2 [E, f, d]; b2 [E, d].
+    Returns (y [N, d], aux_loss scalar fp32).
+
+    Routing: top-k softmax gating with a static capacity C per expert
+    (tokens beyond capacity are dropped — their combine weight is zero and
+    the residual path carries them, as in GShard).  aux_loss is the
+    load-balancing loss E * sum_e(mean_tokens(prob_e) * frac_tokens(top1==e))
+    plus an optional router z-loss.
+    """
+    N, d = x.shape
+    E = wg.shape[1]
+    C = capacity
+    compute_dtype = x.dtype
+
+    # --- router (always fp32: small matmul, numerically sensitive) --------
+    logits = x.astype(jnp.float32) @ wg.astype(jnp.float32)       # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    remaining = probs
+    fill = jnp.zeros((E,), jnp.float32)        # slots already taken
+    combine = jnp.zeros((N, E, C), jnp.float32)
+    denom = jnp.zeros((N,), jnp.float32)
+    top1_mask = None
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)                       # [N]
+        mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)           # [N, E]
+        if top1_mask is None:
+            top1_mask = mask
+        remaining = remaining * (1.0 - mask)
+        gate = (probs * mask).sum(-1)                              # [N]
+        # position of each token within its expert's capacity buffer
+        pos = (jnp.cumsum(mask, axis=0) - 1.0 + fill[None, :])
+        pos_tok = (pos * mask).sum(-1)                             # [N]
+        fill = fill + mask.sum(0)
+        # one_hot of an out-of-range position is all-zero => overflow drops
+        slot = jax.nn.one_hot(pos_tok.astype(jnp.int32), C,
+                              dtype=jnp.float32)                   # [N, C]
+        part = mask[:, :, None] * slot[:, None, :]                 # [N, E, C]
+        combine = combine + gate[:, None, None] * part
+        denom = denom + gate * part.sum((1, 2))
+    combine = combine / jnp.maximum(denom, 1e-9)[:, None, None]
+    dispatch = (combine > 0.0).astype(compute_dtype)
+
+    # --- load-balancing aux loss (GShard eq.(4) / Switch) ------------------
+    me = probs.mean(axis=0)                                        # [E]
+    ce = top1_mask.mean(axis=0)                                    # [E]
+    aux = E * jnp.sum(me * ce)
+    if z_loss_weight:
+        aux = aux + z_loss_weight * jnp.mean(
+            jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
+
+    # --- expert compute: [N,*] <-> [E,C,*] einsums become all-to-all over
+    # "ep" under GSPMD; the ffn matmuls run per-expert on the MXU ----------
+    xin = jnp.einsum("nec,nd->ecd", dispatch, x)
+    xin = _maybe_shard(xin, "ep", None, None)
+    h = jnp.einsum("ecd,edf->ecf", xin, w1.astype(compute_dtype)) \
+        + b1.astype(compute_dtype)[:, None, :]
+    h = _maybe_shard(_activation(act)(h), "ep", None, "mp")
+    out = jnp.einsum("ecf,efd->ecd", h, w2.astype(compute_dtype)) \
+        + b2.astype(compute_dtype)[:, None, :]
+    out = _maybe_shard(out, "ep", None, None)
+    y = jnp.einsum("nec,ecd->nd", combine.astype(compute_dtype), out)
+    return y, aux
+
+
+class MoELayer(Layer):
+    """Drop-in FFN replacement with E experts and top-k routing.
+
+    Reference analog: MoELayer(gate={'type': 'gshard'|'switch'}, experts=...)
+    in paddle.incubate.distributed.models.moe.  Here the per-expert FFNs are
+    a single stacked parameter set annotated over the "ep" mesh axis (build
+    the mesh with ``fleet``'s ``ep_degree`` or ``mesh.build_mesh(ep=...)``);
+    the fleet engine places them like any other annotated parameter.
+
+    top_k=1 is a Switch layer, top_k=2 the GShard default.
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, top_k=2,
+                 capacity_factor=1.25, eval_capacity_factor=2.0,
+                 activation="gelu", z_loss_weight=0.0, name=None):
+        super().__init__()
+        if top_k > num_experts:
+            raise ValueError(f"top_k={top_k} > num_experts={num_experts}")
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.activation = activation
+        self.z_loss_weight = z_loss_weight
+        ep = "ep" if mesh_mod.degree("ep") > 1 else None
+        mp = "mp" if mesh_mod.degree("mp") > 1 else None
+        from jax.sharding import PartitionSpec as P
+        self.gate_weight = self.create_parameter(
+            [d_model, num_experts], default_initializer=I.Normal(0.0, 0.02))
+        self.w1 = self.create_parameter(
+            [num_experts, d_model, d_hidden],
+            default_initializer=I.Normal(0.0, 0.02))
+        self.w1.pspec = P(ep, None, mp)
+        self.b1 = self.create_parameter(
+            [num_experts, d_hidden], is_bias=True,
+            default_initializer=I.Constant(0.0))
+        self.b1.pspec = P(ep, mp)
+        self.w2 = self.create_parameter(
+            [num_experts, d_hidden, d_model],
+            default_initializer=I.Normal(0.0, 0.02))
+        self.w2.pspec = P(ep, mp, None)
+        self.b2 = self.create_parameter(
+            [num_experts, d_model], is_bias=True,
+            default_initializer=I.Constant(0.0))
+        self.b2.pspec = P(ep, None)
+        # last forward's load-balancing loss (a live autograd Tensor); sum
+        # into the training loss via paddle_tpu.incubate.nn.moe_aux_loss()
+        object.__setattr__(self, "_aux_loss", None)
+
+    @property
+    def aux_loss(self):
+        # NOTE: an AttributeError escaping a property falls through to
+        # Layer.__getattr__ and masks the real failure — keep this body
+        # exception-free.
+        t = self._aux_loss
+        if t is None:
+            return None
+        # a Tracer surviving past its trace (the fleet/jit step already
+        # retraced and returned) is stale — reading it would poison eager
+        # graphs, so report "no aux available" instead
+        if isinstance(t._array, jax.core.Tracer) and _trace_state_clean():
+            return None
+        return t
+
+    def capacity(self, n_tokens):
+        cf = self.capacity_factor if self.training \
+            else self.eval_capacity_factor
+        c = int(math.ceil(cf * self.top_k * n_tokens / self.num_experts))
+        return max(1, min(n_tokens, c))
+
+    def forward(self, x):
+        if mesh_mod.degree("ep") > 1 and self.w1.pspec[0] is None:
+            raise ValueError(
+                "MoELayer was constructed before the expert-parallel mesh "
+                "existed (its experts would silently replicate): call "
+                "fleet.init / mesh.build_mesh(ep=...) BEFORE building the "
+                "model")
+        shape = x.shape
+        d = shape[-1]
+        n = 1
+        for s in shape[:-1]:
+            n *= s
+        x2 = x.reshape([n, d])
+        out = engine.apply(
+            "moe_ffn", moe_ffn,
+            [x2, self.gate_weight, self.w1, self.b1, self.w2, self.b2],
+            {"top_k": self.top_k, "capacity": self.capacity(n),
+             "act": self.activation, "z_loss_weight": self.z_loss_weight})
+        y, aux = out
+        # bypass Layer.__setattr__: the live aux Tensor must NOT register
+        # as a parameter (it is a per-forward activation)
+        object.__setattr__(self, "_aux_loss", aux)
+        return y.reshape(list(shape))
+
+
+def moe_aux_loss(model):
+    """Sum the load-balancing aux losses of every MoELayer after a forward
+    (the reference accumulates them on the gate objects the same way).
+    Returns a scalar Tensor, or None if the model has no routed layers."""
+    total = None
+    for layer in model.sublayers(include_self=True):
+        if isinstance(layer, MoELayer) and layer.aux_loss is not None:
+            total = layer.aux_loss if total is None \
+                else total + layer.aux_loss
+    return total

@@ -38,7 +38,9 @@ class GPTConfig:
                  max_position_embeddings=2048, hidden_dropout=0.1,
                  attention_dropout=0.1, initializer_range=0.02,
                  use_recompute=False, sequence_parallel=False,
-                 tensor_parallel=None):
+                 tensor_parallel=None, num_experts=0, moe_top_k=2,
+                 moe_capacity_factor=1.25, moe_every=1,
+                 moe_aux_weight=0.01):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -50,6 +52,14 @@ class GPTConfig:
         self.initializer_range = initializer_range
         self.use_recompute = use_recompute
         self.sequence_parallel = sequence_parallel
+        # MoE (GShard/Switch style): num_experts > 0 replaces the FFN of
+        # every `moe_every`-th block with a routed MoELayer (reference
+        # analog: GPT-MoE configs in the incubate moe stack)
+        self.num_experts = num_experts
+        self.moe_top_k = moe_top_k
+        self.moe_capacity_factor = moe_capacity_factor
+        self.moe_every = moe_every
+        self.moe_aux_weight = moe_aux_weight
         # default: tensor-parallel layers iff an mp axis exists
         self.tensor_parallel = tensor_parallel if tensor_parallel is not None \
             else mesh_mod.degree("mp") > 1
@@ -132,12 +142,21 @@ class GPTMLP(nn.Layer):
 
 
 class GPTBlock(nn.Layer):
-    def __init__(self, cfg: GPTConfig):
+    def __init__(self, cfg: GPTConfig, layer_idx=0):
         super().__init__()
         self.ln_1 = nn.LayerNorm(cfg.hidden_size)
         self.attn = GPTAttention(cfg)
         self.ln_2 = nn.LayerNorm(cfg.hidden_size)
-        self.mlp = GPTMLP(cfg)
+        use_moe = cfg.num_experts > 0 and \
+            (layer_idx + 1) % cfg.moe_every == 0
+        if use_moe:
+            from ..incubate.nn import MoELayer
+            self.mlp = MoELayer(cfg.hidden_size, cfg.intermediate_size,
+                                num_experts=cfg.num_experts,
+                                top_k=cfg.moe_top_k,
+                                capacity_factor=cfg.moe_capacity_factor)
+        else:
+            self.mlp = GPTMLP(cfg)
         self.dropout = nn.Dropout(cfg.hidden_dropout)
 
     def forward(self, x, cache=None):
@@ -159,7 +178,8 @@ class GPTModel(nn.Layer):
         self.wpe = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size,
                                 weight_attr=init)
         self.drop = nn.Dropout(cfg.hidden_dropout)
-        self.h = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.h = nn.LayerList([GPTBlock(cfg, layer_idx=i)
+                               for i in range(cfg.num_layers)])
         self.ln_f = nn.LayerNorm(cfg.hidden_size)
 
     def forward(self, input_ids, position_ids=None, caches=None):
@@ -264,6 +284,14 @@ class GPTPretrainingCriterion(nn.Layer):
 
 
 def gpt_loss_fn(model, input_ids, labels):
-    """Canonical pretrain loss for TrainStep/fleet engine."""
+    """Canonical pretrain loss for TrainStep/fleet engine (adds the MoE
+    load-balancing aux loss when the config routes any block)."""
     logits = model(input_ids)
-    return F.cross_entropy(logits, labels, reduction="mean")
+    loss = F.cross_entropy(logits, labels, reduction="mean")
+    cfg = getattr(model, "cfg", None)
+    if cfg is not None and getattr(cfg, "num_experts", 0):
+        from ..incubate.nn import moe_aux_loss
+        aux = moe_aux_loss(model)
+        if aux is not None:
+            loss = loss + cfg.moe_aux_weight * aux
+    return loss
