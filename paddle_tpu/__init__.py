@@ -50,9 +50,17 @@ from .framework import checkpoint  # noqa: F401
 from .framework.checkpoint import save_state, load_state  # noqa: F401
 from .jit import save, load  # noqa: F401  (paddle.save/paddle.load)
 
-# paddle-style aliases
-disable_static = lambda *a, **k: None   # always-dynamic by design
-enable_static = lambda *a, **k: None
+# static-graph mode (framework/static_graph.py): ops keep executing
+# eagerly, but every dispatch is also recorded into the current Program
+# for Executor.run to compile as one XLA call
+from .framework.static_graph import (  # noqa: F401
+    enable_static, disable_static,
+)
+
+
+def in_dynamic_mode():
+    from .framework import static_graph as _sg
+    return not _sg.enabled()
 
 __version__ = "0.1.0"
 

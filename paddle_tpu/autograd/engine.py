@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..framework import static_graph as _static
+
 _tls = threading.local()
 
 
@@ -120,7 +122,10 @@ def apply(name, fn, tensor_args, consts=None):
 
     if not record:
         out = fn(*arrays, **consts)
-        return _wrap_out(out, stop_gradient=True)
+        result = _wrap_out(out, stop_gradient=True)
+        if _static.enabled():
+            _static.record_op(name, fn, tensor_args, consts, result)
+        return result
 
     def closed_fn(*diff_arrays):
         full = list(arrays)
@@ -141,6 +146,8 @@ def apply(name, fn, tensor_args, consts=None):
         else:
             # integer-valued outputs of a diff op (e.g. argmax aux) carry no grad
             t.stop_gradient = True
+    if _static.enabled():
+        _static.record_op(name, fn, tensor_args, consts, result)
     return result
 
 

@@ -1,0 +1,434 @@
+"""Static-graph capture (reference analog: paddle's Program/Block/Operator
+IR built by the static API — python/paddle/base/framework.py Program +
+executor.py — where `paddle.enable_static()` makes every op call append an
+OpDesc instead of executing).
+
+TPU-native: ops still EXECUTE eagerly at build time (shape/dtype propagation
+for free — placeholders hold zero arrays), but every dispatch through the
+autograd engine also appends a node to the current Program when any input is
+graph-tracked.  `Executor.run(feed, fetch_list)` then replays the recorded
+DAG as ONE pure jax function — jit-compiled per feed-shape signature, so the
+"Program" is an XLA program, which is exactly what the reference's
+executor + CINN pipeline produced.  `optimizer.minimize(loss)` in static
+mode registers a training op: each run computes grads of the recorded loss
+and applies the optimizer's functional update inside the same XLA program.
+
+Known capture boundary: anything that does not flow through the op dispatch
+layer (host numpy math on `.numpy()` reads) is baked as a constant.
+"""
+from __future__ import annotations
+
+import contextlib
+
+_state = {"enabled": False, "main": None, "startup": None}
+
+
+def enabled() -> bool:
+    return _state["enabled"]
+
+
+# ------------------------------------------------------------------- nodes
+class FeedNode:
+    __slots__ = ("name", "shape", "dtype")
+
+    def __init__(self, name, shape, dtype):
+        self.name = name
+        self.shape = shape
+        self.dtype = dtype
+
+
+class LeafNode:
+    """A live Tensor captured by reference: its CURRENT array is read at run
+    time, so eager updates (optimizer steps, BN stats) stay visible."""
+    __slots__ = ("tensor", "trainable")
+
+    def __init__(self, tensor):
+        self.tensor = tensor
+        self.trainable = not tensor.stop_gradient
+
+
+class ConstNode:
+    __slots__ = ("array",)
+
+    def __init__(self, array):
+        self.array = array
+
+
+class OpNode:
+    __slots__ = ("name", "fn", "parents", "consts", "n_outs")
+
+    def __init__(self, name, fn, parents, consts, n_outs):
+        self.name = name
+        self.fn = fn
+        self.parents = parents          # list of (node, out_index)
+        self.consts = consts
+        self.n_outs = n_outs
+
+
+# ----------------------------------------------------------------- program
+class Program:
+    """Recorded op DAG (reference: base.framework.Program)."""
+
+    def __init__(self, is_startup=False):
+        self.ops = []
+        self.feeds = {}                 # name -> FeedNode
+        self._leaf_by_id = {}           # id(Tensor) -> LeafNode
+        self._leaf_keepalive = []
+        self._train = None              # {"optimizer", "loss", "state", ...}
+        self._is_startup = is_startup
+
+    # reference-API parity shims
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        """for_test=True: same graph, but WITHOUT the registered training
+        op — fetches run pure forward (reference: Program.clone pruning the
+        backward/optimize ops)."""
+        if not for_test:
+            return self
+        p = Program.__new__(Program)
+        p.ops = self.ops
+        p.feeds = self.feeds
+        p._leaf_by_id = self._leaf_by_id
+        p._leaf_keepalive = self._leaf_keepalive
+        p._train = None
+        p._is_startup = False
+        return p
+
+    @property
+    def random_seed(self):
+        return 0
+
+    def leaf_for(self, tensor):
+        node = self._leaf_by_id.get(id(tensor))
+        if node is None:
+            if tensor.persistable or not tensor.stop_gradient:
+                node = LeafNode(tensor)
+            else:
+                node = ConstNode(tensor._array)
+            # keep EVERY keyed tensor alive: a freed tensor's id() can be
+            # recycled by a later tensor, which would silently alias it to
+            # this node's baked value
+            self._leaf_keepalive.append(tensor)
+            self._leaf_by_id[id(tensor)] = node
+        return (node, 0)
+
+    def add_feed(self, name, shape, dtype):
+        if name in self.feeds:
+            raise ValueError(f"duplicate static.data name {name!r}")
+        node = FeedNode(name, shape, dtype)
+        self.feeds[name] = node
+        return node
+
+    def leaves(self):
+        seen, t_leaves, f_leaves = set(), [], []
+        for node in self._leaf_by_id.values():
+            if isinstance(node, LeafNode) and id(node) not in seen:
+                seen.add(id(node))
+                (t_leaves if node.trainable else f_leaves).append(node)
+        return t_leaves, f_leaves
+
+
+def default_main_program() -> Program:
+    if _state["main"] is None:
+        _state["main"] = Program()
+    return _state["main"]
+
+
+def default_startup_program() -> Program:
+    if _state["startup"] is None:
+        _state["startup"] = Program(is_startup=True)
+    return _state["startup"]
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    prev = (_state["main"], _state["startup"])
+    _state["main"] = main_program
+    if startup_program is not None:
+        _state["startup"] = startup_program
+    try:
+        yield
+    finally:
+        _state["main"], _state["startup"] = prev
+
+
+def enable_static():
+    _state["enabled"] = True
+    if _state["main"] is None:
+        _state["main"] = Program()
+    if _state["startup"] is None:
+        _state["startup"] = Program(is_startup=True)
+
+
+def disable_static():
+    _state["enabled"] = False
+
+
+def reset():
+    _state["main"] = Program()
+    _state["startup"] = Program(is_startup=True)
+
+
+# ---------------------------------------------------------------- recording
+def record_op(name, fn, tensor_args, consts, result):
+    """Called from autograd.engine.apply on every dispatched op while static
+    mode is on; appends an OpNode when any input is graph-tracked."""
+    prog = _state["main"]
+    if prog is None:
+        return
+    # record when any input is graph-tracked OR is a parameter/buffer:
+    # param-only chains (e.g. weight-standardization w * s) must stay
+    # differentiable-to-the-real-parameter, not freeze into pseudo-leaves
+    if not any(getattr(t, "_sym", None) is not None
+               or t.persistable or not t.stop_gradient
+               for t in tensor_args):
+        return
+    from ..tensor import Tensor
+    parents = []
+    for t in tensor_args:
+        sym = getattr(t, "_sym", None)
+        parents.append(sym if sym is not None else prog.leaf_for(t))
+    outs = result if isinstance(result, tuple) else (result,)
+    node = OpNode(name, fn, parents, dict(consts or {}), len(outs))
+    prog.ops.append(node)
+    for i, o in enumerate(outs):
+        if isinstance(o, Tensor):
+            o._sym = (node, i)
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Create a feed placeholder (reference: paddle.static.data).  Returns a
+    Tensor holding zeros (None dims -> 1) so shape/dtype propagate at build;
+    Executor.run substitutes the fed value."""
+    if not _state["enabled"]:
+        raise RuntimeError("static.data requires paddle.enable_static()")
+    import jax.numpy as jnp
+    from ..dtypes import convert_dtype
+    from ..tensor import Tensor
+    node = default_main_program().add_feed(name, tuple(shape), dtype)
+    concrete = [1 if (d is None or int(d) < 0) else int(d) for d in shape]
+    t = Tensor._from_array(
+        jnp.zeros(concrete, convert_dtype(dtype)), stop_gradient=True)
+    t.name = name
+    t._sym = (node, 0)
+    return t
+
+
+# --------------------------------------------------------------- evaluation
+def _build_forward(refs):
+    """Pure function evaluating graph `refs` given leaf/feed arrays."""
+
+    def forward(t_arrays, f_arrays, feed_arrays, t_leaves, f_leaves):
+        env = {}
+        for n, a in zip(t_leaves, t_arrays):
+            env[id(n)] = (a,)
+        for n, a in zip(f_leaves, f_arrays):
+            env[id(n)] = (a,)
+
+        def materialize(node):
+            if isinstance(node, FeedNode):
+                return (feed_arrays[node.name],)
+            if isinstance(node, ConstNode):
+                return (node.array,)
+            # LeafNode created after fn was built (signature is re-derived
+            # per run, so this is only a safety net) — read it live
+            return (node.tensor._array,)
+
+        def ev(ref):
+            # iterative post-order walk: deep Programs (hundreds of
+            # sequential ops) must not hit Python's recursion limit
+            stack = [ref[0]]
+            while stack:
+                node = stack[-1]
+                k = id(node)
+                if k in env:
+                    stack.pop()
+                    continue
+                if not isinstance(node, OpNode):
+                    env[k] = materialize(node)
+                    stack.pop()
+                    continue
+                pending = [p[0] for p in node.parents
+                           if id(p[0]) not in env]
+                if pending:
+                    stack.extend(pending)
+                    continue
+                args = [env[id(p)][i] for p, i in node.parents]
+                out = node.fn(*args, **node.consts)
+                env[k] = out if isinstance(out, tuple) else (out,)
+                stack.pop()
+            return env[id(ref[0])][ref[1]]
+
+        return [ev(r) for r in refs]
+
+    return forward
+
+
+class Executor:
+    """Runs a recorded Program as one jitted XLA call (reference:
+    paddle.static.Executor over the C++ StandaloneExecutor)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def close(self):
+        self._cache.clear()
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        import numpy as np
+        prog = program if program is not None else default_main_program()
+        if getattr(prog, "_loaded_call", None) is not None:
+            return prog._loaded_call(feed or {}, fetch_list, return_numpy)
+        if prog._is_startup:
+            return []   # parameters are initialized eagerly at build
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        refs = []
+        for t in fetch_list:
+            sym = getattr(t, "_sym", None)
+            if sym is None:
+                raise ValueError(
+                    "fetch target was not recorded in this program (it was "
+                    "computed outside static mode or from no feed/leaf)")
+            refs.append(sym)
+        feed_arrays = {k: (v._array if hasattr(v, "_array") else
+                           np.asarray(v)) for k, v in feed.items()}
+        missing = [n for n in prog.feeds if n not in feed_arrays]
+        used = self._used_feeds(prog, refs)
+        missing = [n for n in missing if n in used]
+        if missing:
+            raise ValueError(f"feed missing placeholders: {missing}")
+
+        if prog._train is not None:
+            outs = self._run_train(prog, refs, feed_arrays)
+        else:
+            outs = self._run_infer(prog, refs, feed_arrays)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        from ..tensor import Tensor
+        return [Tensor._from_array(o) for o in outs]
+
+    # ----------------------------------------------------------- internals
+    def _used_feeds(self, prog, refs):
+        used, seen = set(), set()
+        stack = [r[0] for r in refs]
+        if prog._train is not None:
+            stack.append(prog._train["loss_ref"][0])
+        while stack:
+            node = stack.pop()
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            if isinstance(node, FeedNode):
+                used.add(node.name)
+            elif isinstance(node, OpNode):
+                stack.extend(p[0] for p in node.parents)
+        return used
+
+    def _signature(self, prog, refs, feed_arrays, train):
+        # feed_arrays hold jax or numpy arrays — read shape/dtype attrs
+        # directly (np.asarray on a device array would force a D2H copy
+        # on every run)
+        return (id(prog), len(prog.ops), tuple(refs_id(refs)), train,
+                tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                             for k, v in feed_arrays.items())))
+
+    def _run_infer(self, prog, refs, feed_arrays):
+        import jax
+        t_leaves, f_leaves = prog.leaves()
+        key = self._signature(prog, refs, feed_arrays, train=False)
+        fn = self._cache.get(key)
+        if fn is None:
+            forward = _build_forward(refs)
+
+            def pure(t_arrays, f_arrays, feed_arrays):
+                return forward(t_arrays, f_arrays, feed_arrays,
+                               t_leaves, f_leaves)
+
+            fn = jax.jit(pure)
+            self._cache[key] = fn
+        return fn([n.tensor._array for n in t_leaves],
+                  [n.tensor._array for n in f_leaves], feed_arrays)
+
+    def _run_train(self, prog, refs, feed_arrays):
+        import jax
+        import jax.numpy as jnp
+        tr = prog._train
+        opt = tr["optimizer"]
+        t_leaves, f_leaves = prog.leaves()
+        params = [n.tensor for n in t_leaves]
+        if tr.get("state") is not None and len(params) != len(tr["names"]):
+            raise RuntimeError(
+                f"program gained {len(params) - len(tr['names'])} trainable "
+                "leaves after training started; build the whole graph "
+                "before the first Executor.run")
+        if tr.get("state") is None:
+            tr["state"] = opt.init_state([p._array for p in params])
+            gmap = getattr(opt, "_group_by_id", {})
+            tr["names"] = [p.name or f"param_{i}"
+                           for i, p in enumerate(params)]
+            tr["scales"] = [gmap.get(id(p), (1.0, None))[0] for p in params]
+            tr["wds"] = [gmap.get(id(p), (1.0, None))[1] for p in params]
+            tr["clip"] = [(getattr(p, "optimize_attr", None) or {}).get(
+                "need_clip", True) for p in params]
+        key = self._signature(prog, refs, feed_arrays, train=True)
+        fn = self._cache.get(key)
+        if fn is None:
+            all_refs = [tr["loss_ref"]] + refs
+            forward = _build_forward(all_refs)
+            names, scales, wds, clipm = (tr["names"], tr["scales"],
+                                         tr["wds"], tr["clip"])
+
+            def pure(t_arrays, f_arrays, feed_arrays, opt_state, lr, step):
+                def loss_fn(ta):
+                    outs = forward(ta, f_arrays, feed_arrays,
+                                   t_leaves, f_leaves)
+                    return outs[0], outs[1:]
+
+                (loss, fetches), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(t_arrays)
+                grads = opt._clip_grad_arrays(grads, need_clip=clipm)
+                new_p, new_s = opt.update(
+                    grads, t_arrays, opt_state, lr, step,
+                    param_names=names, lr_scales=scales, wd_overrides=wds)
+                return fetches, loss, new_p, new_s
+
+            fn = jax.jit(pure)
+            self._cache[key] = fn
+        tr["step"] = tr.get("step", 0) + 1
+        fetches, loss, new_p, tr["state"] = fn(
+            [p._array for p in params],
+            [n.tensor._array for n in f_leaves], feed_arrays,
+            tr["state"], jnp.asarray(opt.get_lr(), jnp.float32),
+            jnp.asarray(tr["step"], jnp.float32))
+        for p, a in zip(params, new_p):
+            p._inplace_assign(a)
+        opt._step_count = tr["step"]
+        # fetches[i] aligns with refs[i]; the loss fetch reuses the value
+        # already computed for the grad pass
+        return [loss if r == tr["loss_ref"] else fetches[i]
+                for i, r in enumerate(refs)]
+
+
+def refs_id(refs):
+    return [(id(n), i) for n, i in refs]
+
+
+def register_minimize(optimizer, loss):
+    """optimizer.minimize(loss) under static mode: record ONE training op
+    (grads of the recorded loss + functional optimizer update are executed
+    inside Executor.run's jitted program)."""
+    prog = _state["main"]
+    sym = getattr(loss, "_sym", None)
+    if prog is None or sym is None:
+        raise RuntimeError(
+            "minimize() in static mode needs a loss recorded in the "
+            "current program")
+    if prog._train is not None:
+        raise NotImplementedError(
+            "one optimizer per static Program is supported")
+    prog._train = {"optimizer": optimizer, "loss_ref": sym}

@@ -210,6 +210,12 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..framework import static_graph as _sg
+        if _sg.enabled() and getattr(loss, "_sym", None) is not None:
+            # static mode: register the train op; Executor.run executes
+            # grads + this optimizer's functional update in ONE XLA program
+            _sg.register_minimize(self, loss)
+            return
         loss.backward()
         self.step()
         self.clear_grad()

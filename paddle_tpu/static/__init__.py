@@ -1,0 +1,163 @@
+"""paddle.static surface (reference: python/paddle/static/*).
+
+The reference builds a Program IR and runs it on the C++ executor; here the
+Program is an op DAG captured at dispatch time (framework/static_graph.py)
+and Executor.run compiles it to ONE XLA program per feed signature — see
+that module's docstring for the design.  save/load_inference_model
+round-trips through StableHLO like jit.save.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from ..framework.static_graph import (  # noqa: F401
+    Executor, Program, data, default_main_program, default_startup_program,
+    program_guard,
+)
+from ..jit.save_load import InputSpec  # noqa: F401
+
+
+class nn:
+    """Tiny paddle.static.nn analog: layer-creating ops for classic static
+    programs.  Parameters are created eagerly (startup is a no-op) and
+    captured as graph leaves.  Layers are cached PER PROGRAM (keyed by an
+    explicit name, or by creation order) so re-running the build code
+    against the same program reuses its parameters, while a fresh program
+    gets fresh ones."""
+
+    @staticmethod
+    def _cache():
+        prog = default_main_program()
+        if not hasattr(prog, "_static_nn_layers"):
+            prog._static_nn_layers = {}
+        return prog._static_nn_layers
+
+    @staticmethod
+    def _get(key_prefix, name, factory):
+        cache = nn._cache()
+        key = name or f"{key_prefix}_{cache.get('__counter__', 0)}"
+        if name is None:
+            cache["__counter__"] = cache.get("__counter__", 0) + 1
+        layer = cache.get(key)
+        if layer is None:
+            layer = factory()
+            cache[key] = layer
+        return layer
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        from .. import nn as dnn
+        layer = nn._get("fc", name,
+                        lambda: dnn.Linear(int(x.shape[-1]), size))
+        out = layer(x)
+        if activation is not None:
+            from ..nn import functional as F
+            out = getattr(F, activation)(out)
+        return out
+
+    @staticmethod
+    def embedding(x, size, param_attr=None, name=None):
+        from .. import nn as dnn
+        layer = nn._get("emb", name,
+                        lambda: dnn.Embedding(int(size[0]), int(size[1])))
+        return layer(x)
+
+
+_MODEL = "static_model.stablehlo"
+_META = "static_meta.json"
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    """Export the recorded graph fetch_vars = f(feed_vars) to StableHLO
+    with all leaves (parameters/buffers) baked as constants."""
+    import jax
+    import numpy as np
+    from jax import export as jexport
+
+    from ..framework import static_graph as SG
+    from ..jit.save_load import _shape_structs
+
+    prog = default_main_program()
+    refs = []
+    for t in fetch_vars:
+        sym = getattr(t, "_sym", None)
+        if sym is None:
+            raise ValueError("fetch var was not recorded in the program")
+        refs.append(sym)
+    feed_nodes = []
+    for t in feed_vars:
+        sym = getattr(t, "_sym", None)
+        if sym is None or not isinstance(sym[0], SG.FeedNode):
+            raise ValueError("feed var must come from paddle.static.data")
+        feed_nodes.append(sym[0])
+    t_leaves, f_leaves = prog.leaves()
+    t_arrays = [n.tensor._array for n in t_leaves]
+    f_arrays = [n.tensor._array for n in f_leaves]
+    forward = SG._build_forward(refs)
+
+    def pure(*in_arrays):
+        feed_arrays = {n.name: a for n, a in zip(feed_nodes, in_arrays)}
+        return forward(t_arrays, f_arrays, feed_arrays, t_leaves, f_leaves)
+
+    specs = [InputSpec(shape=list(n.shape), dtype=n.dtype, name=n.name)
+             for n in feed_nodes]
+    in_structs = _shape_structs(specs)
+    exported = jexport.export(jax.jit(pure))(*in_structs)
+
+    path = os.path.abspath(path_prefix)
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, _MODEL), "wb") as f:
+        f.write(exported.serialize())
+    with open(os.path.join(path, _META), "w") as f:
+        json.dump({"feed_names": [n.name for n in feed_nodes],
+                   "n_fetch": len(refs),
+                   "feed_specs": [{"shape": [d if d is None else int(d)
+                                             for d in n.shape],
+                                   "dtype": str(np.dtype(n.dtype))
+                                   if not isinstance(n.dtype, str)
+                                   else n.dtype}
+                                  for n in feed_nodes]}, f)
+
+
+class _LoadedProgram(Program):
+    """Program stand-in whose run path calls the deserialized StableHLO."""
+
+    def __init__(self, exported, meta):
+        super().__init__()
+        self._exported = exported
+        self._meta = meta
+
+    def _loaded_call(self, feed, fetch_list, return_numpy):
+        import numpy as np
+        from ..tensor import Tensor
+        arrays = []
+        for name in self._meta["feed_names"]:
+            if name not in feed:
+                raise ValueError(f"missing feed {name!r}")
+            v = feed[name]
+            arrays.append(v._array if isinstance(v, Tensor)
+                          else np.asarray(v))
+        outs = self._exported.call(*arrays)
+        outs = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+        if fetch_list:  # fetch targets are output indices (see loader)
+            outs = [outs[int(i)] for i in fetch_list]
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor._from_array(o) for o in outs]
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns (program, feed_target_names, fetch_targets) — run with
+    exe.run(program, feed={...}, fetch_list=fetch_targets)."""
+    from jax import export as jexport
+
+    path = os.path.abspath(path_prefix)
+    with open(os.path.join(path, _MODEL), "rb") as f:
+        exported = jexport.deserialize(f.read())
+    with open(os.path.join(path, _META)) as f:
+        meta = json.load(f)
+    prog = _LoadedProgram(exported, meta)
+    fetch_targets = list(range(meta["n_fetch"]))
+    return prog, list(meta["feed_names"]), fetch_targets

@@ -1,0 +1,124 @@
+"""paddle.static surface (reference: python/paddle/static) — Program capture,
+Executor train/infer runs, clone(for_test), save/load_inference_model."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.framework import static_graph as SG
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    SG.reset()
+    yield
+    SG.reset()
+    paddle.disable_static()
+
+
+def _build_regression():
+    main, startup = paddle.static.Program(), paddle.static.Program()
+    with paddle.static.program_guard(main, startup):
+        x = paddle.static.data("x", [None, 4], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        model = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+        pred = model(x)
+        loss = F.mse_loss(pred, y)
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=model.parameters())
+        opt.minimize(loss)
+    return main, startup, x, y, pred, loss
+
+
+def test_static_training_converges(static_mode):
+    main, startup, x, y, pred, loss = _build_regression()
+    exe = paddle.static.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 1).astype(np.float32)
+    losses = []
+    for _ in range(30):
+        xb = rng.randn(16, 4).astype(np.float32)
+        (lv,) = exe.run(main, feed={"x": xb, "y": xb @ w},
+                        fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.1
+
+
+def test_static_clone_for_test_is_pure(static_mode):
+    main, startup, x, y, pred, loss = _build_regression()
+    test_prog = main.clone(for_test=True)
+    exe = paddle.static.Executor()
+    feed = {"x": np.ones((3, 4), np.float32),
+            "y": np.zeros((3, 1), np.float32)}
+    (p1,) = exe.run(test_prog, feed=feed, fetch_list=[pred])
+    (p2,) = exe.run(test_prog, feed=feed, fetch_list=[pred])
+    np.testing.assert_allclose(p1, p2)  # no optimizer side effects
+
+
+def test_static_batch_polymorphism(static_mode):
+    """None dims accept any batch size (one jit per feed signature)."""
+    main, startup, x, y, pred, loss = _build_regression()
+    test_prog = main.clone(for_test=True)
+    exe = paddle.static.Executor()
+    for b in (2, 5):
+        (pv,) = exe.run(test_prog,
+                        feed={"x": np.ones((b, 4), np.float32),
+                              "y": np.zeros((b, 1), np.float32)},
+                        fetch_list=[pred])
+        assert pv.shape == (b, 1)
+
+
+def test_static_missing_feed_raises(static_mode):
+    main, startup, x, y, pred, loss = _build_regression()
+    exe = paddle.static.Executor()
+    with pytest.raises(ValueError, match="feed"):
+        exe.run(main.clone(for_test=True),
+                feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss])
+
+
+def test_static_save_load_inference_model(static_mode, tmp_path):
+    main, startup, x, y, pred, loss = _build_regression()
+    exe = paddle.static.Executor()
+    feed = {"x": np.ones((3, 4), np.float32),
+            "y": np.zeros((3, 1), np.float32)}
+    (pv,) = exe.run(main.clone(for_test=True), feed=feed, fetch_list=[pred])
+    path = os.path.join(str(tmp_path), "inf")
+    with paddle.static.program_guard(main, startup):
+        paddle.static.save_inference_model(path, [x], [pred], exe)
+    prog, feed_names, fetch_targets = paddle.static.load_inference_model(path)
+    assert feed_names == ["x"]
+    (out,) = exe.run(prog, feed={"x": feed["x"]}, fetch_list=fetch_targets)
+    np.testing.assert_allclose(out, pv, rtol=1e-5)
+
+
+def test_static_nn_fc(static_mode):
+    exe = paddle.static.Executor()
+    with paddle.static.program_guard(paddle.static.Program()):
+        x2 = paddle.static.data("x2", [None, 8], "float32")
+        h = paddle.static.nn.fc(x2, 4, activation="relu")
+        (hv,) = exe.run(feed={"x2": np.ones((2, 8), np.float32)},
+                        fetch_list=[h])
+    assert hv.shape == (2, 4) and (hv >= 0).all()
+
+
+def test_dynamic_mode_untouched_after_static(static_mode):
+    _build_regression()
+    paddle.disable_static()
+    assert paddle.in_dynamic_mode()
+    t = paddle.randn([2, 3])
+    t.stop_gradient = False
+    s = (t * 2.0).sum()
+    s.backward()
+    assert t.grad is not None
+
+
+def test_data_requires_static_mode():
+    assert paddle.in_dynamic_mode()
+    with pytest.raises(RuntimeError, match="enable_static"):
+        paddle.static.data("q", [None, 2], "float32")
