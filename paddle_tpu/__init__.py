@@ -41,6 +41,7 @@ from . import sparse  # noqa: F401
 from . import audio  # noqa: F401
 from . import hapi  # noqa: F401
 from . import incubate  # noqa: F401
+from . import geometric  # noqa: F401
 from . import callbacks  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .framework.param_attr import ParamAttr  # noqa: F401

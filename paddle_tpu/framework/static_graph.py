@@ -19,8 +19,25 @@ layer (host numpy math on `.numpy()` reads) is baked as a constant.
 from __future__ import annotations
 
 import contextlib
+import itertools
+import warnings
 
 _state = {"enabled": False, "main": None, "startup": None}
+_graph_ids = itertools.count(1)
+
+# train-only ops remapped when a clone(for_test=True) program replays
+# (reference: Program.clone rewrites op test attrs)
+_TEST_REMAP = {
+    "dropout_k": lambda x, key=None, p=0.5: x,
+    "dropout_nodiv_k": lambda x, key=None, p=0.5: x * (1.0 - p),
+    "dropout2d_k": lambda x, key=None, p=0.5: x,
+}
+# ops whose per-run randomness must be re-threaded instead of replaying
+# the build-time key baked into consts.  (Key-less creation RNG —
+# uniform_k/normal_k with no tensor inputs — is a known capture boundary:
+# it records no node and bakes as a constant, like the reference's
+# startup-program initializers.)
+_RNG_OPS = {"dropout_k", "dropout_nodiv_k", "dropout2d_k"}
 
 
 def enabled() -> bool:
@@ -29,40 +46,49 @@ def enabled() -> bool:
 
 # ------------------------------------------------------------------- nodes
 class FeedNode:
-    __slots__ = ("name", "shape", "dtype")
+    __slots__ = ("name", "shape", "dtype", "graph_id", "seq")
 
-    def __init__(self, name, shape, dtype):
+    def __init__(self, name, shape, dtype, graph_id, seq):
         self.name = name
         self.shape = shape
         self.dtype = dtype
+        self.graph_id = graph_id
+        self.seq = seq
 
 
 class LeafNode:
     """A live Tensor captured by reference: its CURRENT array is read at run
     time, so eager updates (optimizer steps, BN stats) stay visible."""
-    __slots__ = ("tensor", "trainable")
+    __slots__ = ("tensor", "trainable", "graph_id", "seq")
 
-    def __init__(self, tensor):
+    def __init__(self, tensor, graph_id, seq):
         self.tensor = tensor
         self.trainable = not tensor.stop_gradient
+        self.graph_id = graph_id
+        self.seq = seq
 
 
 class ConstNode:
-    __slots__ = ("array",)
+    __slots__ = ("array", "graph_id", "seq")
 
-    def __init__(self, array):
+    def __init__(self, array, graph_id, seq):
         self.array = array
+        self.graph_id = graph_id
+        self.seq = seq
 
 
 class OpNode:
-    __slots__ = ("name", "fn", "parents", "consts", "n_outs")
+    __slots__ = ("name", "fn", "parents", "consts", "n_outs", "graph_id",
+                 "seq")
 
-    def __init__(self, name, fn, parents, consts, n_outs):
+    def __init__(self, name, fn, parents, consts, n_outs, graph_id, seq):
         self.name = name
         self.fn = fn
         self.parents = parents          # list of (node, out_index)
         self.consts = consts
         self.n_outs = n_outs
+        self.graph_id = graph_id
+        self.seq = seq
 
 
 # ----------------------------------------------------------------- program
@@ -76,6 +102,13 @@ class Program:
         self._leaf_keepalive = []
         self._train = None              # {"optimizer", "loss", "state", ...}
         self._is_startup = is_startup
+        self._for_test = False
+        # stable identity shared with clone(for_test) views; used to reject
+        # fetches/parents recorded in a DIFFERENT program (a stale _sym
+        # would otherwise silently evaluate the wrong graph), and as the
+        # Executor cache key (id() of freed objects can recycle)
+        self.graph_id = next(_graph_ids)
+        self._node_seq = itertools.count()
 
     # reference-API parity shims
     def global_block(self):
@@ -83,8 +116,8 @@ class Program:
 
     def clone(self, for_test=False):
         """for_test=True: same graph, but WITHOUT the registered training
-        op — fetches run pure forward (reference: Program.clone pruning the
-        backward/optimize ops)."""
+        op, and train-only ops (dropout) replayed as inference (reference:
+        Program.clone pruning backward/optimize ops + op test attrs)."""
         if not for_test:
             return self
         p = Program.__new__(Program)
@@ -94,6 +127,9 @@ class Program:
         p._leaf_keepalive = self._leaf_keepalive
         p._train = None
         p._is_startup = False
+        p._for_test = True
+        p.graph_id = self.graph_id
+        p._node_seq = self._node_seq
         return p
 
     @property
@@ -104,9 +140,11 @@ class Program:
         node = self._leaf_by_id.get(id(tensor))
         if node is None:
             if tensor.persistable or not tensor.stop_gradient:
-                node = LeafNode(tensor)
+                node = LeafNode(tensor, self.graph_id,
+                                next(self._node_seq))
             else:
-                node = ConstNode(tensor._array)
+                node = ConstNode(tensor._array, self.graph_id,
+                                 next(self._node_seq))
             # keep EVERY keyed tensor alive: a freed tensor's id() can be
             # recycled by a later tensor, which would silently alias it to
             # this node's baked value
@@ -117,7 +155,8 @@ class Program:
     def add_feed(self, name, shape, dtype):
         if name in self.feeds:
             raise ValueError(f"duplicate static.data name {name!r}")
-        node = FeedNode(name, shape, dtype)
+        node = FeedNode(name, shape, dtype, self.graph_id,
+                        next(self._node_seq))
         self.feeds[name] = node
         return node
 
@@ -186,12 +225,26 @@ def record_op(name, fn, tensor_args, consts, result):
                for t in tensor_args):
         return
     from ..tensor import Tensor
+    if name == "batch_norm_train" and not getattr(prog, "_bn_warned", False):
+        prog._bn_warned = True
+        warnings.warn(
+            "BatchNorm recorded in a static Program: per-step normalization "
+            "uses batch statistics correctly, but RUNNING statistics only "
+            "reflect the build-time forward (host-side updates do not "
+            "replay). For BN models prefer jit.to_static / TrainStep, or "
+            "rebuild the graph under model.eval() for inference.",
+            stacklevel=3)
     parents = []
     for t in tensor_args:
         sym = getattr(t, "_sym", None)
+        # a _sym from another program (stale after reset, or cross-program
+        # reuse) must not splice that graph in here — re-capture by value
+        if sym is not None and sym[0].graph_id != prog.graph_id:
+            sym = None
         parents.append(sym if sym is not None else prog.leaf_for(t))
     outs = result if isinstance(result, tuple) else (result,)
-    node = OpNode(name, fn, parents, dict(consts or {}), len(outs))
+    node = OpNode(name, fn, parents, dict(consts or {}), len(outs),
+                  prog.graph_id, next(prog._node_seq))
     prog.ops.append(node)
     for i, o in enumerate(outs):
         if isinstance(o, Tensor):
@@ -217,11 +270,18 @@ def data(name, shape, dtype="float32", lod_level=0):
 
 
 # --------------------------------------------------------------- evaluation
-def _build_forward(refs):
-    """Pure function evaluating graph `refs` given leaf/feed arrays."""
+def _build_forward(refs, for_test=False):
+    """Pure function evaluating graph `refs` given leaf/feed arrays.
 
-    def forward(t_arrays, f_arrays, feed_arrays, t_leaves, f_leaves):
+    for_test replays train-only ops (dropout) as inference; otherwise a
+    non-None `rng` re-threads per-run randomness into RNG ops in place of
+    the build-time key baked in their consts."""
+    import jax
+
+    def forward(t_arrays, f_arrays, feed_arrays, t_leaves, f_leaves,
+                rng=None):
         env = {}
+        rng_seq = {}
         for n, a in zip(t_leaves, t_arrays):
             env[id(n)] = (a,)
         for n, a in zip(f_leaves, f_arrays):
@@ -256,7 +316,14 @@ def _build_forward(refs):
                     stack.extend(pending)
                     continue
                 args = [env[id(p)][i] for p, i in node.parents]
-                out = node.fn(*args, **node.consts)
+                fn_, consts = node.fn, node.consts
+                if for_test and node.name in _TEST_REMAP:
+                    fn_ = _TEST_REMAP[node.name]
+                elif rng is not None and node.name in _RNG_OPS:
+                    seq = rng_seq.setdefault(k, len(rng_seq))
+                    consts = dict(consts)
+                    consts["key"] = jax.random.fold_in(rng, seq)
+                out = fn_(*args, **consts)
                 env[k] = out if isinstance(out, tuple) else (out,)
                 stack.pop()
             return env[id(ref[0])][ref[1]]
@@ -273,9 +340,11 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
         self._cache = {}
+        self._feeds_cache = {}
 
     def close(self):
         self._cache.clear()
+        self._feeds_cache.clear()
 
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True):
@@ -290,10 +359,11 @@ class Executor:
         refs = []
         for t in fetch_list:
             sym = getattr(t, "_sym", None)
-            if sym is None:
+            if sym is None or sym[0].graph_id != prog.graph_id:
                 raise ValueError(
                     "fetch target was not recorded in this program (it was "
-                    "computed outside static mode or from no feed/leaf)")
+                    "computed outside static mode, before a reset, or in a "
+                    "different Program)")
             refs.append(sym)
         feed_arrays = {k: (v._array if hasattr(v, "_array") else
                            np.asarray(v)) for k, v in feed.items()}
@@ -314,6 +384,11 @@ class Executor:
 
     # ----------------------------------------------------------- internals
     def _used_feeds(self, prog, refs):
+        key = (prog.graph_id, len(prog.ops), tuple(refs_id(refs)),
+               prog._train is not None)
+        cached = self._feeds_cache.get(key)
+        if cached is not None:
+            return cached
         used, seen = set(), set()
         stack = [r[0] for r in refs]
         if prog._train is not None:
@@ -327,32 +402,38 @@ class Executor:
                 used.add(node.name)
             elif isinstance(node, OpNode):
                 stack.extend(p[0] for p in node.parents)
+        self._feeds_cache[key] = used
         return used
 
     def _signature(self, prog, refs, feed_arrays, train):
+        # keyed on graph_id + node seq (NOT id(): ids of freed
+        # programs/nodes recycle, and every clone() is a fresh object);
         # feed_arrays hold jax or numpy arrays — read shape/dtype attrs
         # directly (np.asarray on a device array would force a D2H copy
         # on every run)
-        return (id(prog), len(prog.ops), tuple(refs_id(refs)), train,
+        return (prog.graph_id, len(prog.ops), tuple(refs_id(refs)), train,
                 tuple(sorted((k, tuple(v.shape), str(v.dtype))
                              for k, v in feed_arrays.items())))
 
     def _run_infer(self, prog, refs, feed_arrays):
         import jax
+        from . import random as _random
         t_leaves, f_leaves = prog.leaves()
-        key = self._signature(prog, refs, feed_arrays, train=False)
+        key = self._signature(prog, refs, feed_arrays, train=False) \
+            + (prog._for_test,)
         fn = self._cache.get(key)
         if fn is None:
-            forward = _build_forward(refs)
+            forward = _build_forward(refs, for_test=prog._for_test)
 
-            def pure(t_arrays, f_arrays, feed_arrays):
+            def pure(t_arrays, f_arrays, feed_arrays, rng):
                 return forward(t_arrays, f_arrays, feed_arrays,
-                               t_leaves, f_leaves)
+                               t_leaves, f_leaves, rng=rng)
 
             fn = jax.jit(pure)
             self._cache[key] = fn
         return fn([n.tensor._array for n in t_leaves],
-                  [n.tensor._array for n in f_leaves], feed_arrays)
+                  [n.tensor._array for n in f_leaves], feed_arrays,
+                  _random.next_key())
 
     def _run_train(self, prog, refs, feed_arrays):
         import jax
@@ -360,17 +441,30 @@ class Executor:
         tr = prog._train
         opt = tr["optimizer"]
         t_leaves, f_leaves = prog.leaves()
-        params = [n.tensor for n in t_leaves]
-        if tr.get("state") is not None and len(params) != len(tr["names"]):
+        # only the optimizer's OWN parameters get updates (reference
+        # semantics: minimize touches the optimizer's param list); other
+        # trainable leaves in the program stay frozen inputs
+        opt_ids = {id(p) for p in opt._parameters}
+        upd = [n for n in t_leaves if id(n.tensor) in opt_ids]
+        frz = [n for n in t_leaves if id(n.tensor) not in opt_ids]
+        t_leaves = upd + frz
+        params = [n.tensor for n in upd]
+        if tr.get("idx") is not None and len(params) != len(tr["idx"]):
             raise RuntimeError(
-                f"program gained {len(params) - len(tr['names'])} trainable "
+                f"program gained {len(params) - len(tr['idx'])} trainable "
                 "leaves after training started; build the whole graph "
                 "before the first Executor.run")
-        if tr.get("state") is None:
-            tr["state"] = opt.init_state([p._array for p in params])
+        if tr.get("idx") is None:
+            # optimizer state lives in opt._state (full param-list layout),
+            # so optimizer.state_dict()/set_state_dict round-trips static
+            # training; tr['idx'] maps program order -> optimizer order
+            if opt._state is None:
+                opt._state = opt.init_state(
+                    [p._array for p in opt._parameters])
+            by_id = {id(p): i for i, p in enumerate(opt._parameters)}
+            tr["idx"] = [by_id[id(p)] for p in params]
             gmap = getattr(opt, "_group_by_id", {})
-            tr["names"] = [p.name or f"param_{i}"
-                           for i, p in enumerate(params)]
+            tr["names"] = [p.name or f"param_{by_id[id(p)]}" for p in params]
             tr["scales"] = [gmap.get(id(p), (1.0, None))[0] for p in params]
             tr["wds"] = [gmap.get(id(p), (1.0, None))[1] for p in params]
             tr["clip"] = [(getattr(p, "optimize_attr", None) or {}).get(
@@ -383,30 +477,36 @@ class Executor:
             names, scales, wds, clipm = (tr["names"], tr["scales"],
                                          tr["wds"], tr["clip"])
 
-            def pure(t_arrays, f_arrays, feed_arrays, opt_state, lr, step):
-                def loss_fn(ta):
-                    outs = forward(ta, f_arrays, feed_arrays,
-                                   t_leaves, f_leaves)
+            def pure(u_arrays, z_arrays, f_arrays, feed_arrays, opt_state,
+                     lr, step, rng):
+                def loss_fn(ua):
+                    outs = forward(list(ua) + list(z_arrays), f_arrays,
+                                   feed_arrays, t_leaves, f_leaves, rng=rng)
                     return outs[0], outs[1:]
 
                 (loss, fetches), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(t_arrays)
+                    loss_fn, has_aux=True)(u_arrays)
                 grads = opt._clip_grad_arrays(grads, need_clip=clipm)
                 new_p, new_s = opt.update(
-                    grads, t_arrays, opt_state, lr, step,
+                    grads, u_arrays, opt_state, lr, step,
                     param_names=names, lr_scales=scales, wd_overrides=wds)
                 return fetches, loss, new_p, new_s
 
             fn = jax.jit(pure)
             self._cache[key] = fn
         tr["step"] = tr.get("step", 0) + 1
-        fetches, loss, new_p, tr["state"] = fn(
+        from . import random as _random
+        fetches, loss, new_p, new_s = fn(
             [p._array for p in params],
+            [n.tensor._array for n in frz],
             [n.tensor._array for n in f_leaves], feed_arrays,
-            tr["state"], jnp.asarray(opt.get_lr(), jnp.float32),
-            jnp.asarray(tr["step"], jnp.float32))
+            [opt._state[i] for i in tr["idx"]],
+            jnp.asarray(opt.get_lr(), jnp.float32),
+            jnp.asarray(tr["step"], jnp.float32), _random.next_key())
         for p, a in zip(params, new_p):
             p._inplace_assign(a)
+        for i, slots in zip(tr["idx"], new_s):
+            opt._state[i] = slots
         opt._step_count = tr["step"]
         # fetches[i] aligns with refs[i]; the loss fetch reuses the value
         # already computed for the grad pass
@@ -415,7 +515,7 @@ class Executor:
 
 
 def refs_id(refs):
-    return [(id(n), i) for n, i in refs]
+    return [(n.graph_id, n.seq, i) for n, i in refs]
 
 
 def register_minimize(optimizer, loss):

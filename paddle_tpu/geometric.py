@@ -1,0 +1,126 @@
+"""paddle.geometric analog (reference: python/paddle/geometric/* —
+segment_* reductions and the send_u_recv / send_ue_recv message-passing
+ops used by PGL graph models).
+
+TPU-native: all ops lower to jax.ops.segment_* / gather, which XLA turns
+into sorted-scatter kernels; everything is tape-recorded through the op
+dispatch layer so message passing is differentiable.  Segment counts are
+data-dependent in the reference; eagerly we read them from the concrete
+ids, under jit pass `out_size` (static shapes are an XLA requirement).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ops import call as _call
+from .ops.dispatch import register
+from .tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(data=x)
+
+
+def _n_segments(segment_ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    arr = segment_ids._array if isinstance(segment_ids, Tensor) \
+        else jnp.asarray(segment_ids)
+    if isinstance(arr, jax.core.Tracer):
+        raise ValueError(
+            "segment count is data-dependent; pass out_size= when tracing "
+            "under jit (static shapes)")
+    return int(arr.max()) + 1 if arr.size else 0
+
+
+@register("segment_sum", amp="keep")
+def _segment_sum_k(x, ids, n):
+    return jax.ops.segment_sum(x, ids, num_segments=n)
+
+
+@register("segment_mean", amp="keep")
+def _segment_mean_k(x, ids, n):
+    tot = jax.ops.segment_sum(x, ids, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones_like(ids, x.dtype), ids,
+                              num_segments=n)
+    shape = (n,) + (1,) * (x.ndim - 1)
+    return tot / jnp.maximum(cnt, 1).reshape(shape)
+
+
+@register("segment_max", amp="keep")
+def _segment_max_k(x, ids, n):
+    out = jax.ops.segment_max(x, ids, num_segments=n)
+    # empty segments: the reference emits 0, jax emits -inf
+    return jnp.where(jnp.isfinite(out), out, jnp.zeros_like(out))
+
+
+@register("segment_min", amp="keep")
+def _segment_min_k(x, ids, n):
+    out = jax.ops.segment_min(x, ids, num_segments=n)
+    return jnp.where(jnp.isfinite(out), out, jnp.zeros_like(out))
+
+
+def segment_sum(data, segment_ids, name=None, out_size=None):
+    return _call("segment_sum", _t(data), _t(segment_ids),
+                 n=_n_segments(segment_ids, out_size))
+
+
+def segment_mean(data, segment_ids, name=None, out_size=None):
+    return _call("segment_mean", _t(data), _t(segment_ids),
+                 n=_n_segments(segment_ids, out_size))
+
+
+def segment_max(data, segment_ids, name=None, out_size=None):
+    return _call("segment_max", _t(data), _t(segment_ids),
+                 n=_n_segments(segment_ids, out_size))
+
+
+def segment_min(data, segment_ids, name=None, out_size=None):
+    return _call("segment_min", _t(data), _t(segment_ids),
+                 n=_n_segments(segment_ids, out_size))
+
+
+_REDUCERS = {"sum": "segment_sum", "mean": "segment_mean",
+             "max": "segment_max", "min": "segment_min"}
+
+
+@register("gather0", amp="keep")
+def _gather0_k(x, idx):
+    return jnp.take(x, idx, axis=0)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather source-node features along edges and segment-reduce them at
+    the destination nodes (reference: paddle.geometric.send_u_recv)."""
+    if reduce_op not in _REDUCERS:
+        raise ValueError(f"reduce_op must be one of {list(_REDUCERS)}")
+    x, src_index, dst_index = _t(x), _t(src_index), _t(dst_index)
+    n = out_size if out_size is not None else x.shape[0]
+    msg = _call("gather0", x, src_index)
+    return _call(_REDUCERS[reduce_op], msg, dst_index, n=int(n))
+
+
+@register("mul", amp="keep")
+def _edge_mul_k(a, b):
+    return a * b
+
+
+_MSG_OPS = {"add": lambda a, b: a + b, "sub": lambda a, b: a - b,
+            "mul": lambda a, b: a * b, "div": lambda a, b: a / b}
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Like send_u_recv but combines the gathered node features with edge
+    features `y` via message_op before reducing."""
+    if message_op not in _MSG_OPS:
+        raise ValueError(f"message_op must be one of {list(_MSG_OPS)}")
+    if reduce_op not in _REDUCERS:
+        raise ValueError(f"reduce_op must be one of {list(_REDUCERS)}")
+    x, y = _t(x), _t(y)
+    src_index, dst_index = _t(src_index), _t(dst_index)
+    n = out_size if out_size is not None else x.shape[0]
+    msg = _MSG_OPS[message_op](_call("gather0", x, src_index), y)
+    return _call(_REDUCERS[reduce_op], msg, dst_index, n=int(n))

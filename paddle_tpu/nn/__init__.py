@@ -6,7 +6,8 @@ from .layer import Layer  # noqa: F401
 from .common import (  # noqa: F401
     Linear, Embedding, Dropout, Dropout2D, AlphaDropout, Flatten, Identity,
     Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, Pad1D, Pad2D,
-    PixelShuffle, CosineSimilarity, Bilinear,
+    ZeroPad2D, PixelShuffle, PixelUnshuffle, ChannelShuffle, Softmax2D,
+    CosineSimilarity, Bilinear, PairwiseDistance, Fold, Unfold,
     ReLU, ReLU6, GELU, SiLU, Swish, Mish, Sigmoid, Tanh, Hardswish,
     Hardsigmoid, Hardtanh, LeakyReLU, ELU, CELU, SELU, Softplus, Softshrink,
     Hardshrink, Softsign, Tanhshrink, LogSigmoid, Softmax, LogSoftmax, GLU,
@@ -18,7 +19,7 @@ from .container import (  # noqa: F401
 from .conv import Conv1D, Conv2D, Conv3D, Conv2DTranspose  # noqa: F401
 from .pooling import (  # noqa: F401
     MaxPool2D, AvgPool2D, AdaptiveAvgPool2D, AdaptiveMaxPool2D, MaxPool1D,
-    AvgPool1D,
+    AvgPool1D, MaxUnpool2D,
 )
 from .norm import (  # noqa: F401
     LayerNorm, RMSNorm, GroupNorm, BatchNorm, BatchNorm1D, BatchNorm2D,
@@ -28,10 +29,14 @@ from .transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer,
 )
-from .rnn import SimpleRNN, LSTM, GRU, LSTMCell, GRUCell  # noqa: F401
+from .rnn import (  # noqa: F401
+    SimpleRNN, LSTM, GRU, LSTMCell, GRUCell, SimpleRNNCell, BiRNN,
+)
 from .loss import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, NLLLoss, BCELoss,
     BCEWithLogitsLoss, KLDivLoss, MarginRankingLoss, CosineEmbeddingLoss,
+    CTCLoss, TripletMarginLoss, SoftMarginLoss, HingeEmbeddingLoss,
+    PoissonNLLLoss, GaussianNLLLoss, MultiLabelSoftMarginLoss,
 )
 from .clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,

@@ -132,12 +132,10 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train"):
 def dropout2d(x, p=0.5, training=True):
     if not training or p == 0.0:
         return _t(x)
-    xt = _t(x)
-    key = _random.next_key()
-    import jax
-    mask = jax.random.bernoulli(key, 1.0 - p, xt._array.shape[:2] + (1, 1))
-    m = Tensor._from_array(mask.astype(xt._array.dtype) / (1.0 - p))
-    return xt * m
+    # keyed dispatch op (not ad-hoc jax.random here) so static capture can
+    # re-thread the key per run / disable it in test clones
+    return ops.call("dropout2d_k", _t(x), key=_random.next_key(),
+                    p=float(p))
 
 
 def alpha_dropout(x, p=0.5, training=True):
@@ -556,3 +554,145 @@ def sequence_mask(lengths, maxlen=None, dtype="bool"):
     m = int(maxlen) if maxlen is not None else int(lt.max())
     mask = jnp.arange(m)[None, :] < lt[..., None]
     return Tensor._from_array(mask.astype(dtypes.convert_dtype(dtype)))
+
+
+# ----------------------------------------------------- round-2 nn additions
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss (reference: python/paddle/nn/functional/loss.py ctc_loss).
+    log_probs [T, B, C] unnormalized activations (log_softmax applied in
+    the kernel, matching warpctc's contract)."""
+    loss = ops.call("ctc_loss", _t(log_probs), _t(labels),
+                    _t(input_lengths), _t(label_lengths), blank=blank)
+    if norm_by_times:
+        loss = loss / _t(input_lengths).astype(loss.dtype)
+    if reduction == "mean":
+        # reference: mean over batch of per-sample loss / label_length
+        return (loss / _t(label_lengths).astype(loss.dtype)
+                .clip(min=1.0)).mean()
+    return _reduce_loss(loss, reduction)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    return ops.call("fold", _t(x), output_sizes=output_sizes,
+                    kernel_sizes=kernel_sizes, strides=strides,
+                    paddings=paddings, dilations=dilations)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW"):
+    k = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    s = k if stride is None else (
+        (stride, stride) if isinstance(stride, int) else tuple(stride))
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    xin, idx = _t(x), _t(indices)
+    if data_format == "NHWC":
+        xin = xin.transpose([0, 3, 1, 2])
+        idx = idx.transpose([0, 3, 1, 2])
+    if output_size is None:
+        oh = (xin.shape[2] - 1) * s[0] - 2 * p[0] + k[0]
+        ow = (xin.shape[3] - 1) * s[1] - 2 * p[1] + k[1]
+    else:
+        oh, ow = output_size[-2], output_size[-1]
+    out = ops.call("max_unpool2d", xin, idx, out_h=int(oh), out_w=int(ow))
+    if data_format == "NHWC":
+        out = out.transpose([0, 2, 3, 1])
+    return out
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    d = _t(x) - _t(y) + epsilon
+    from .. import tensor_api as T
+    return T.norm(d, p=p, axis=-1, keepdim=keepdim)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    from .. import tensor_api as T
+    dp = pairwise_distance(input, positive, p, epsilon)
+    dn = pairwise_distance(input, negative, p, epsilon)
+    if swap:
+        dn2 = pairwise_distance(positive, negative, p, epsilon)
+        dn = T.minimum(dn, dn2)
+    loss = (dp - dn + margin).clip(min=0.0)
+    return _reduce_loss(loss, reduction)
+
+
+def soft_margin_loss(input, label, reduction="mean"):
+    # log(1+exp(z)) == softplus(z); the registered kernel is
+    # threshold-stabilized so large logits don't overflow to inf
+    loss = softplus(-_t(label) * _t(input))
+    return _reduce_loss(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    from .. import tensor_api as T
+    it, lt = _t(input), _t(label)
+    loss = T.where(lt == 1.0, it, (margin - it).clip(min=0.0))
+    return _reduce_loss(loss, reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean"):
+    it, lt = _t(input), _t(label)
+    if log_input:
+        loss = it.exp() - lt * it
+    else:
+        loss = it - lt * (it + epsilon).log()
+    if full:
+        # Stirling approximation for the label! term, applied where y > 1
+        from .. import tensor_api as T
+        import math
+        stirling = lt * lt.clip(min=1.0).log() - lt \
+            + 0.5 * (2.0 * math.pi * lt.clip(min=1.0)).log()
+        loss = loss + T.where(lt > 1.0, stirling,
+                              T.zeros_like(stirling))
+    return _reduce_loss(loss, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    it, lt = _t(input), _t(label)
+    var = _t(variance).clip(min=epsilon)
+    loss = 0.5 * (var.log() + (it - lt) ** 2 / var)
+    if full:
+        import math
+        loss = loss + 0.5 * math.log(2.0 * math.pi)
+    return _reduce_loss(loss, reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean"):
+    # per-class stable BCE-with-logits, averaged over classes
+    loss = ops.call("bce_with_logits", _t(input), _t(label))
+    if weight is not None:
+        loss = loss * _t(weight)
+    loss = loss.mean(axis=-1)
+    return _reduce_loss(loss, reduction)
+
+
+def channel_shuffle(x, groups, data_format="NCHW"):
+    xt = _t(x)
+    if data_format == "NHWC":
+        xt = xt.transpose([0, 3, 1, 2])
+    n, c, h, w = xt.shape
+    if c % groups:
+        raise ValueError(f"channels {c} not divisible by groups {groups}")
+    out = xt.reshape([n, groups, c // groups, h, w]) \
+        .transpose([0, 2, 1, 3, 4]).reshape([n, c, h, w])
+    return out.transpose([0, 2, 3, 1]) if data_format == "NHWC" else out
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = downscale_factor
+    xt = _t(x)
+    if data_format == "NHWC":
+        xt = xt.transpose([0, 3, 1, 2])
+    n, c, h, w = xt.shape
+    if h % r or w % r:
+        raise ValueError(f"spatial dims ({h},{w}) not divisible by {r}")
+    out = xt.reshape([n, c, h // r, r, w // r, r])
+    out = out.transpose([0, 1, 3, 5, 2, 4]).reshape(
+        [n, c * r * r, h // r, w // r])
+    return out.transpose([0, 2, 3, 1]) if data_format == "NHWC" else out

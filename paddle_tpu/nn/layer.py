@@ -33,7 +33,12 @@ class Layer:
         params = self.__dict__.get("_parameters")
         layers = self.__dict__.get("_sub_layers")
         buffers = self.__dict__.get("_buffers")
-        if isinstance(value, Tensor) and (
+        if isinstance(value, Tensor) and buffers is not None \
+                and name in buffers:
+            # an existing buffer stays a buffer even when the new tensor is
+            # persistable (buffers are persistable by default)
+            buffers[name] = value
+        elif isinstance(value, Tensor) and (
                 not value.stop_gradient or getattr(value, "persistable",
                                                    False)):
             # persistable covers frozen params (ParamAttr(trainable=False)):
@@ -108,6 +113,11 @@ class Layer:
         self._buffers[name] = tensor
         if not persistable:
             self._non_persistable_buffer_names.add(name)
+        else:
+            # mark the tensor itself (reference: Variable.persistable) so
+            # subsystems that only see the tensor — static-graph leaf
+            # capture — treat it as live state, not a bakeable constant
+            tensor.persistable = True
         return tensor
 
     # ------------------------------------------------------------ traversal

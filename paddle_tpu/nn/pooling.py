@@ -78,3 +78,20 @@ class AvgPool1D(Layer):
                            (self.padding, 0) if isinstance(self.padding, int)
                            else self.padding, exclusive=self.exclusive)
         return out.squeeze(-1)
+
+
+class MaxUnpool2D(Layer):
+    """Inverse of MaxPool2D given the argmax mask (reference:
+    paddle.nn.MaxUnpool2D; pair with max_pool2d(..., return_mask=True))."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.output_size)

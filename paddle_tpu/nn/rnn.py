@@ -272,3 +272,72 @@ class GRUCell(Layer):
             [inputs, h, self.weight_ih, self.weight_hh, self.bias_ih,
              self.bias_hh])
         return h2, h2
+
+
+class SimpleRNNCell(Layer):
+    def __init__(self, input_size, hidden_size, activation="tanh"):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.hidden_size = hidden_size
+        self.activation = activation
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size],
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size],
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [hidden_size], default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [hidden_size], default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        from .. import tensor_api as T
+        if states is None:
+            states = T.zeros([inputs.shape[0], self.hidden_size],
+                             dtype=inputs._array.dtype)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def cell_fn(xt, h_, w_ih, w_hh, b_ih, b_hh):
+            return act(xt @ w_ih.T + b_ih + h_ @ w_hh.T + b_hh)
+
+        h2 = engine.apply(
+            "simple_rnn_cell", cell_fn,
+            [inputs, states, self.weight_ih, self.weight_hh, self.bias_ih,
+             self.bias_hh])
+        return h2, h2
+
+
+class BiRNN(Layer):
+    """Wrap two cells into a bidirectional scan (reference:
+    paddle.nn.BiRNN over RNN cell pairs): outputs concatenated on the
+    feature axis, states returned per direction."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+
+    def _scan(self, cell, x, state, reverse):
+        from .. import tensor_api as T
+        steps = range(x.shape[1] - 1, -1, -1) if reverse \
+            else range(x.shape[1])
+        outs = [None] * x.shape[1]
+        for t in steps:
+            o, state = cell(x[:, t], state)
+            outs[t] = o
+        return T.stack(outs, axis=1), state
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .. import tensor_api as T
+        x = inputs if not self.time_major else inputs.transpose([1, 0, 2])
+        sf = sb = None
+        if initial_states is not None:
+            sf, sb = initial_states
+        of, sf = self._scan(self.cell_fw, x, sf, reverse=False)
+        ob, sb = self._scan(self.cell_bw, x, sb, reverse=True)
+        out = T.concat([of, ob], axis=-1)
+        if self.time_major:
+            out = out.transpose([1, 0, 2])
+        return out, (sf, sb)

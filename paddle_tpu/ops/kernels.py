@@ -433,6 +433,10 @@ register("dropout_k", lambda x, key, p=0.5:
 register("dropout_nodiv_k", lambda x, key, p=0.5:
          jnp.where(jax.random.bernoulli(key, 1.0 - p, x.shape), x,
                    jnp.zeros_like(x)))
+register("dropout2d_k", lambda x, key, p=0.5:
+         x * (jax.random.bernoulli(key, 1.0 - p, x.shape[:2] + (1,) *
+                                   (x.ndim - 2)).astype(x.dtype)
+              / (1.0 - p)))
 register("uniform_k", lambda key, shape, dtype, min=0.0, max=1.0:
          jax.random.uniform(key, shape, dtype, min, max))
 register("normal_k", lambda key, shape, dtype, mean=0.0, std=1.0:

@@ -48,6 +48,29 @@ def conv2d_k(x, w, stride=1, padding=0, dilation=1, groups=1,
         dimension_numbers=dn, feature_group_count=groups)
 
 
+@register("s2d_stem_conv", amp="allow")
+def s2d_stem_conv_k(x, w):
+    """7x7/stride-2/pad-3 stem conv computed as space-to-depth(2) + 4x4
+    stride-1 conv — numerically identical, but the MXU sees 12 input
+    channels at 112x112 instead of 3 at 224x224 (the MLPerf ResNet TPU
+    trick: a 3-channel contraction uses ~2% of the 128 MXU lanes).
+
+    x [b, c, H, W] (H, W even); w [o, c, 7, 7].
+    """
+    b, c, H, W = x.shape
+    o = w.shape[0]
+    z = x.reshape(b, c, H // 2, 2, W // 2, 2)
+    z = z.transpose(0, 1, 3, 5, 2, 4).reshape(b, c * 4, H // 2, W // 2)
+    # pad the kernel top-left to 8x8, then split each spatial dim into
+    # (tap, parity) matching the space-to-depth channel packing
+    w8 = jnp.pad(w, ((0, 0), (0, 0), (1, 0), (1, 0)))
+    w4 = w8.reshape(o, c, 4, 2, 4, 2)
+    w4 = w4.transpose(0, 1, 3, 5, 2, 4).reshape(o, c * 4, 4, 4)
+    return lax.conv_general_dilated(
+        z, w4, window_strides=(1, 1), padding=((2, 1), (2, 1)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
 @register("conv1d", amp="allow")
 def conv1d_k(x, w, stride=1, padding=0, dilation=1, groups=1):
     s = (int(stride),) if isinstance(stride, int) else tuple(stride)
@@ -378,3 +401,100 @@ def bce_with_logits_k(logit, label, pos_weight=None):
         loss = (1.0 - label) * logit + max_val + jnp.log(
             jnp.exp(-max_val) + jnp.exp(-logit - max_val))
     return loss
+
+
+@register("ctc_loss", amp="deny")
+def ctc_loss_k(logits, labels, input_lengths, label_lengths, blank=0):
+    """CTC negative log-likelihood per batch element (reference:
+    paddle.nn.functional.ctc_loss over warpctc).
+
+    logits [T, B, C] (UNnormalized; log_softmax applied here), labels
+    [B, S] padded with anything, input_lengths [B], label_lengths [B].
+    Standard alpha recursion on the blank-extended label sequence in the
+    log semiring, as one lax.scan over time — static shapes, so the whole
+    loss (and its gradient, via autodiff) is a single XLA program.
+    """
+    T, B, C = logits.shape
+    S = labels.shape[1]
+    L = 2 * S + 1
+    neg_inf = -1e30
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    labels = labels.astype(jnp.int32)
+    ext = jnp.full((B, L), blank, jnp.int32).at[:, 1::2].set(labels)
+    # the s-2 diagonal skip is allowed when ext[s] is a label differing
+    # from ext[s-2]
+    skip_ok = jnp.concatenate(
+        [jnp.zeros((B, 2), bool),
+         (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])], axis=1)
+    batch_idx = jnp.arange(B)[:, None]
+    emit = lp[:, batch_idx, ext]                     # [T, B, L]
+
+    alpha = jnp.full((B, L), neg_inf)
+    alpha = alpha.at[:, 0].set(emit[0, :, 0])
+    alpha = alpha.at[:, 1].set(jnp.where(labels.shape[1] > 0,
+                                         emit[0, :, 1], neg_inf))
+
+    def shift(a, n):
+        return jnp.concatenate(
+            [jnp.full((B, n), neg_inf), a[:, :-n]], axis=1) if n else a
+
+    def body(alpha, t):
+        stay = alpha
+        s1 = shift(alpha, 1)
+        s2 = jnp.where(skip_ok, shift(alpha, 2), neg_inf)
+        merged = jnp.logaddexp(jnp.logaddexp(stay, s1), s2)
+        new = merged + emit[t]
+        # frames beyond a sequence's input length leave alpha unchanged
+        active = (t < input_lengths.astype(jnp.int32))[:, None]
+        return jnp.where(active, new, alpha), None
+
+    alpha, _ = jax.lax.scan(body, alpha, jnp.arange(1, T))
+    ll = labels_len = label_lengths.astype(jnp.int32)
+    last = alpha[batch_idx[:, 0], 2 * ll]            # ends on final blank
+    prev = jnp.where(ll > 0,
+                     alpha[batch_idx[:, 0],
+                           jnp.maximum(2 * ll - 1, 0)], neg_inf)
+    return -jnp.logaddexp(last, prev)
+
+
+@register("fold", amp="keep")
+def fold_k(x, output_sizes, kernel_sizes, strides=1, paddings=0,
+           dilations=1):
+    """col2im — inverse of unfold (reference: paddle.nn.functional.fold).
+    x [N, C*kh*kw, L] -> [N, C, H, W] with overlapping patches summed."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    H, W = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    N = x.shape[0]
+    C = x.shape[1] // (kh * kw)
+    oh = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    cols = x.reshape(N, C, kh, kw, oh, ow)
+    out = jnp.zeros((N, C, H + 2 * ph + dh * kh, W + 2 * pw + dw * kw),
+                    x.dtype)
+    for i in range(kh):          # static small loops: XLA fuses the adds
+        for j in range(kw):
+            out = out.at[:, :,
+                         i * dh: i * dh + sh * oh: sh,
+                         j * dw: j * dw + sw * ow: sw].add(cols[:, :, i, j])
+    return out[:, :, ph:ph + H, pw:pw + W]
+
+
+@register("max_unpool2d", amp="keep")
+def max_unpool2d_k(x, indices, out_h, out_w):
+    """Scatter pooled values back to their argmax positions (reference:
+    paddle.nn.functional.max_unpool2d; indices are flat (H*W) positions
+    from max_pool2d(..., return_mask=True))."""
+    N, C, oh, ow = x.shape
+    flat = jnp.zeros((N, C, out_h * out_w), x.dtype)
+    b = jnp.arange(N)[:, None, None, None]
+    c = jnp.arange(C)[None, :, None, None]
+    # .set, not .add: with overlapping pool windows (stride < kernel) one
+    # input element can be the argmax of two windows; both scatters carry
+    # the same value and must not double it
+    flat = flat.at[b, c, indices.astype(jnp.int32)].set(x)
+    return flat.reshape(N, C, out_h, out_w)

@@ -21,10 +21,9 @@ from ..jit.save_load import InputSpec  # noqa: F401
 class nn:
     """Tiny paddle.static.nn analog: layer-creating ops for classic static
     programs.  Parameters are created eagerly (startup is a no-op) and
-    captured as graph leaves.  Layers are cached PER PROGRAM (keyed by an
-    explicit name, or by creation order) so re-running the build code
-    against the same program reuses its parameters, while a fresh program
-    gets fresh ones."""
+    captured as graph leaves.  Layers are cached PER PROGRAM; reuse across
+    calls requires an explicit `name` (unnamed calls create a fresh layer
+    each time, matching the reference's auto-unique parameter names)."""
 
     @staticmethod
     def _cache():
@@ -48,8 +47,17 @@ class nn:
     @staticmethod
     def fc(x, size, num_flatten_dims=1, activation=None, name=None):
         from .. import nn as dnn
-        layer = nn._get("fc", name,
-                        lambda: dnn.Linear(int(x.shape[-1]), size))
+        nfd = num_flatten_dims if num_flatten_dims >= 0 else x.ndim - 1
+        in_f = 1
+        for d in x.shape[nfd:]:
+            in_f *= int(d)
+        if nfd < x.ndim - 1 or nfd == 0:
+            # reference semantics: flatten dims [num_flatten_dims:] into
+            # one; -1 on the batch axis keeps the graph feed-polymorphic
+            shape = ([-1] + list(x.shape[1:nfd]) if nfd >= 1 else []) \
+                + [in_f]
+            x = x.reshape(shape)
+        layer = nn._get("fc", name, lambda: dnn.Linear(in_f, size))
         out = layer(x)
         if activation is not None:
             from ..nn import functional as F

@@ -1,0 +1,77 @@
+"""paddle.geometric (reference: python/paddle/geometric) — segment
+reductions + message passing, values vs numpy and gradients."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import geometric as G
+
+
+def test_segment_reductions_match_numpy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(10, 4).astype(np.float32)
+    ids = np.array([0, 0, 1, 1, 1, 2, 2, 3, 3, 3], np.int32)
+    xt, it = pt.to_tensor(x), pt.to_tensor(ids)
+    for op, ref in [
+        (G.segment_sum, lambda rows: rows.sum(0)),
+        (G.segment_mean, lambda rows: rows.mean(0)),
+        (G.segment_max, lambda rows: rows.max(0)),
+        (G.segment_min, lambda rows: rows.min(0)),
+    ]:
+        out = op(xt, it).numpy()
+        want = np.stack([ref(x[ids == s]) for s in range(4)])
+        np.testing.assert_allclose(out, want, rtol=1e-6, atol=1e-6)
+
+
+def test_segment_sum_gradient():
+    x = pt.to_tensor(np.ones((6, 2), np.float32))
+    x.stop_gradient = False
+    ids = pt.to_tensor(np.array([0, 1, 1, 2, 2, 2], np.int32))
+    out = G.segment_sum(x, ids)
+    (out * pt.to_tensor(np.array([[1.], [2.], [3.]], np.float32))).sum() \
+        .backward()
+    # grad of segment_sum is a gather of the upstream cotangent
+    want = np.array([[1, 1], [2, 2], [2, 2], [3, 3], [3, 3], [3, 3]],
+                    np.float32)
+    np.testing.assert_allclose(x.grad.numpy(), want)
+
+
+def test_segment_empty_segment_emits_zero():
+    x = pt.to_tensor(np.ones((2, 3), np.float32))
+    ids = pt.to_tensor(np.array([0, 2], np.int32))
+    out = G.segment_max(x, ids).numpy()
+    assert out.shape == (3, 3)
+    np.testing.assert_allclose(out[1], 0.0)
+
+
+def test_send_u_recv():
+    x = pt.to_tensor(np.arange(8, dtype=np.float32).reshape(4, 2))
+    src = pt.to_tensor(np.array([0, 1, 2, 3], np.int32))
+    dst = pt.to_tensor(np.array([1, 1, 3, 3], np.int32))
+    out = G.send_u_recv(x, src, dst, reduce_op="sum").numpy()
+    want = np.zeros((4, 2), np.float32)
+    want[1] = x.numpy()[0] + x.numpy()[1]
+    want[3] = x.numpy()[2] + x.numpy()[3]
+    np.testing.assert_allclose(out, want)
+
+
+def test_send_ue_recv_mul():
+    x = pt.to_tensor(np.ones((3, 2), np.float32))
+    y = pt.to_tensor(np.array([[2.0, 2.0], [3.0, 3.0]], np.float32))
+    src = pt.to_tensor(np.array([0, 1], np.int32))
+    dst = pt.to_tensor(np.array([2, 2], np.int32))
+    out = G.send_ue_recv(x, y, src, dst, message_op="mul",
+                         reduce_op="sum").numpy()
+    np.testing.assert_allclose(out[2], [5.0, 5.0])
+
+
+def test_segment_out_size_under_jit():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.dispatch import call_raw
+
+    def f(x, ids):
+        return call_raw("segment_sum", x, ids, n=4)
+
+    out = jax.jit(f)(jnp.ones((5, 2)), jnp.array([0, 1, 1, 3, 3]))
+    assert out.shape == (4, 2)

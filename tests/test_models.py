@@ -23,6 +23,29 @@ def test_resnet50_forward():
     assert m(x).shape == [1, 10]
 
 
+def test_resnet_s2d_stem_parity():
+    """space-to-depth stem (bench MXU trick) is numerically identical to
+    the plain 7x7/s2 stem — same parameters, same outputs."""
+    from paddle_tpu.ops.dispatch import call_raw
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 3, 32, 32), jnp.float32)
+    w = jnp.asarray(rng.randn(16, 3, 7, 7), jnp.float32)
+    ref = call_raw("conv2d", x, w, stride=2, padding=3)
+    s2d = call_raw("s2d_stem_conv", x, w)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(s2d),
+                               rtol=1e-4, atol=1e-4)
+
+    pt.seed(0)
+    m1 = pt.vision.models.resnet50(num_classes=10)
+    m2 = pt.vision.models.resnet50(num_classes=10, s2d_stem=True)
+    m2.set_state_dict(m1.state_dict())
+    m1.eval(); m2.eval()
+    xt = pt.randn([2, 3, 64, 64])
+    np.testing.assert_allclose(m1(xt).numpy(), m2(xt).numpy(),
+                               rtol=2e-3, atol=2e-3)
+
+
 def test_lenet():
     m = pt.vision.models.LeNet()
     assert m(pt.randn([2, 1, 28, 28])).shape == [2, 10]

@@ -1,0 +1,169 @@
+"""Round-2 nn additions (SURVEY §2 nn bullets): CTC vs torch, fold/unfold
+round-trip, max_unpool scatter, new losses vs torch, cells/BiRNN."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_ctc_loss_matches_torch():
+    rng = np.random.RandomState(0)
+    T, B, C, S = 12, 3, 6, 4
+    logits = rng.randn(T, B, C).astype(np.float32)
+    labels = rng.randint(1, C, (B, S)).astype(np.int32)
+    in_len = np.array([12, 10, 8], np.int32)
+    lb_len = np.array([4, 3, 2], np.int32)
+
+    got = F.ctc_loss(pt.to_tensor(logits), pt.to_tensor(labels),
+                     pt.to_tensor(in_len), pt.to_tensor(lb_len),
+                     blank=0, reduction="none").numpy()
+    want = torch.nn.functional.ctc_loss(
+        torch.log_softmax(torch.tensor(logits), dim=-1),
+        torch.tensor(labels.astype(np.int64)),
+        torch.tensor(in_len.astype(np.int64)),
+        torch.tensor(lb_len.astype(np.int64)),
+        blank=0, reduction="none").numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_grad_and_layer():
+    rng = np.random.RandomState(1)
+    logits = pt.to_tensor(rng.randn(8, 2, 5).astype(np.float32))
+    logits.stop_gradient = False
+    labels = pt.to_tensor(np.array([[1, 2], [3, 4]], np.int32))
+    loss = nn.CTCLoss(blank=0)(logits, labels,
+                               pt.to_tensor(np.array([8, 8], np.int32)),
+                               pt.to_tensor(np.array([2, 2], np.int32)))
+    loss.backward()
+    g = logits.grad.numpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_fold_unfold_roundtrip():
+    """fold(unfold(x)) divides back to x where windows tile exactly."""
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.randn(2, 3, 8, 8).astype(np.float32))
+    cols = F.unfold(x, 2, strides=2)
+    assert cols.shape == [2, 12, 16]
+    back = F.fold(cols, (8, 8), 2, strides=2)
+    np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-6)
+
+
+def test_fold_matches_torch_overlapping():
+    rng = np.random.RandomState(2)
+    cols = rng.randn(2, 3 * 9, 36).astype(np.float32)  # 3x3 kernel on 8x8
+    got = F.fold(pt.to_tensor(cols), (8, 8), 3, strides=1,
+                 paddings=0).numpy()
+    want = torch.nn.functional.fold(torch.tensor(cols), (8, 8), 3).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_max_unpool2d_roundtrip():
+    rng = np.random.RandomState(3)
+    x = pt.to_tensor(rng.randn(2, 3, 8, 8).astype(np.float32))
+    pooled, idx = F.max_pool2d(x, 2, return_mask=True)
+    up = nn.MaxUnpool2D(2)(pooled, idx)
+    assert up.shape == [2, 3, 8, 8]
+    # every pooled max lands back at its argmax position
+    np.testing.assert_allclose(np.sort(np.abs(up.numpy()).reshape(2, 3, -1))
+                               [..., -16:],
+                               np.sort(np.abs(pooled.numpy()).reshape(
+                                   2, 3, -1)), rtol=1e-6)
+
+
+@pytest.mark.parametrize("name,args", [
+    ("triplet_margin_loss", 3), ("soft_margin_loss", 2),
+    ("hinge_embedding_loss", 2), ("multi_label_soft_margin_loss", 2),
+])
+def test_new_losses_match_torch(name, args):
+    rng = np.random.RandomState(4)
+    a = rng.randn(6, 10).astype(np.float32)
+    b = rng.randn(6, 10).astype(np.float32)
+    c = rng.randn(6, 10).astype(np.float32)
+    sign = np.where(rng.rand(6, 10) > 0.5, 1.0, -1.0).astype(np.float32)
+    binary = (sign > 0).astype(np.float32)
+    if name == "triplet_margin_loss":
+        got = F.triplet_margin_loss(pt.to_tensor(a), pt.to_tensor(b),
+                                    pt.to_tensor(c)).numpy()
+        want = torch.nn.functional.triplet_margin_loss(
+            torch.tensor(a), torch.tensor(b), torch.tensor(c)).numpy()
+        rtol = 1e-4
+    elif name == "soft_margin_loss":
+        got = F.soft_margin_loss(pt.to_tensor(a),
+                                 pt.to_tensor(sign)).numpy()
+        want = torch.nn.functional.soft_margin_loss(
+            torch.tensor(a), torch.tensor(sign)).numpy()
+        rtol = 1e-5
+    elif name == "hinge_embedding_loss":
+        got = F.hinge_embedding_loss(pt.to_tensor(a),
+                                     pt.to_tensor(sign)).numpy()
+        want = torch.nn.functional.hinge_embedding_loss(
+            torch.tensor(a), torch.tensor(sign)).numpy()
+        rtol = 1e-5
+    else:
+        got = F.multi_label_soft_margin_loss(pt.to_tensor(a),
+                                             pt.to_tensor(binary)).numpy()
+        want = torch.nn.functional.multilabel_soft_margin_loss(
+            torch.tensor(a), torch.tensor(binary)).numpy()
+        rtol = 1e-5
+    np.testing.assert_allclose(got, want, rtol=rtol, atol=1e-5)
+
+
+def test_gaussian_and_poisson_nll():
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 3).astype(np.float32)
+    y = rng.randn(4, 3).astype(np.float32)
+    var = np.abs(rng.randn(4, 3)).astype(np.float32) + 0.1
+    got = F.gaussian_nll_loss(pt.to_tensor(x), pt.to_tensor(y),
+                              pt.to_tensor(var)).numpy()
+    want = torch.nn.functional.gaussian_nll_loss(
+        torch.tensor(x), torch.tensor(y), torch.tensor(var)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    rate = np.abs(rng.randn(4, 3)).astype(np.float32)
+    tgt = rng.poisson(2.0, (4, 3)).astype(np.float32)
+    got = F.poisson_nll_loss(pt.to_tensor(rate), pt.to_tensor(tgt)).numpy()
+    want = torch.nn.functional.poisson_nll_loss(
+        torch.tensor(rate), torch.tensor(tgt)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_pairwise_distance_and_layers():
+    rng = np.random.RandomState(6)
+    a = rng.randn(5, 8).astype(np.float32)
+    b = rng.randn(5, 8).astype(np.float32)
+    got = nn.PairwiseDistance()(pt.to_tensor(a), pt.to_tensor(b)).numpy()
+    want = torch.nn.functional.pairwise_distance(
+        torch.tensor(a), torch.tensor(b)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    x = pt.to_tensor(rng.randn(2, 8, 4, 4).astype(np.float32))
+    cs = nn.ChannelShuffle(4)(x)
+    assert cs.shape == [2, 8, 4, 4]
+    pu = nn.PixelUnshuffle(2)(x)
+    assert pu.shape == [2, 32, 2, 2]
+    # pixel_unshuffle inverts pixel_shuffle
+    ps = F.pixel_shuffle(pu, 2)
+    np.testing.assert_allclose(ps.numpy(), x.numpy(), rtol=1e-6)
+    sm = nn.Softmax2D()(x)
+    np.testing.assert_allclose(sm.numpy().sum(axis=1),
+                               np.ones((2, 4, 4)), rtol=1e-5)
+    zp = nn.ZeroPad2D(1)(x)
+    assert zp.shape == [2, 8, 6, 6]
+
+
+def test_simple_rnn_cell_and_birnn():
+    pt.seed(0)
+    cell_f = nn.SimpleRNNCell(4, 8)
+    cell_b = nn.SimpleRNNCell(4, 8)
+    x = pt.randn([2, 5, 4])
+    out, (sf, sb) = nn.BiRNN(cell_f, cell_b)(x)
+    assert out.shape == [2, 5, 16]
+    assert sf.shape == [2, 8] and sb.shape == [2, 8]
+    loss = out.mean()
+    loss.backward()
+    assert cell_f.weight_ih.grad is not None
+    assert cell_b.weight_hh.grad is not None

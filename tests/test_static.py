@@ -118,6 +118,99 @@ def test_dynamic_mode_untouched_after_static(static_mode):
     assert t.grad is not None
 
 
+def test_static_minimize_only_touches_optimizer_params(static_mode):
+    """Leaves outside the optimizer's parameter list stay frozen."""
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 4], "float32")
+        a = nn.Linear(4, 4)
+        b = nn.Linear(4, 1)
+        loss = (b(a(x)) ** 2).mean()
+        opt = paddle.optimizer.SGD(learning_rate=0.5,
+                                   parameters=a.parameters())
+        opt.minimize(loss)
+    exe = paddle.static.Executor()
+    aw0, bw0 = a.weight.numpy().copy(), b.weight.numpy().copy()
+    for _ in range(2):
+        exe.run(main, feed={"x": np.ones((4, 4), np.float32)},
+                fetch_list=[loss])
+    assert not np.allclose(a.weight.numpy(), aw0)
+    np.testing.assert_array_equal(b.weight.numpy(), bw0)
+
+
+def test_static_optimizer_state_dict_has_moments(static_mode):
+    main, startup, x, y, pred, loss = _build_regression()
+    exe = paddle.static.Executor()
+    opt = main._train["optimizer"]
+    for _ in range(2):
+        exe.run(main, feed={"x": np.ones((4, 4), np.float32),
+                            "y": np.zeros((4, 1), np.float32)},
+                fetch_list=[loss])
+    sd = opt.state_dict()
+    moment_keys = [k for k in sd if "/" in k]
+    assert moment_keys, "Adam moments must survive static training"
+    assert any(np.abs(np.asarray(sd[k])).sum() > 0 for k in moment_keys)
+
+
+def test_static_fc_num_flatten_dims(static_mode):
+    exe = paddle.static.Executor()
+    with paddle.static.program_guard(paddle.static.Program()):
+        x = paddle.static.data("x", [None, 3, 5], "float32")
+        h = paddle.static.nn.fc(x, 7)  # flattens [3,5] -> 15
+        (hv,) = exe.run(feed={"x": np.ones((2, 3, 5), np.float32)},
+                        fetch_list=[h])
+    assert hv.shape == (2, 7)
+
+
+def test_static_dropout_fresh_mask_per_run(static_mode):
+    """The build-time RNG key must not bake: each Executor.run rethreads
+    randomness, so two runs produce different dropout masks."""
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 64], "float32")
+        h = paddle.nn.functional.dropout(x, 0.5, training=True)
+    exe = paddle.static.Executor()
+    feed = {"x": np.ones((2, 64), np.float32)}
+    (a,) = exe.run(main, feed=feed, fetch_list=[h])
+    (b,) = exe.run(main, feed=feed, fetch_list=[h])
+    assert not np.array_equal(a, b)
+
+
+def test_static_clone_for_test_disables_dropout(static_mode):
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 8], "float32")
+        h = paddle.nn.functional.dropout(x, 0.9, training=True)
+    test_prog = main.clone(for_test=True)
+    exe = paddle.static.Executor()
+    (hv,) = exe.run(test_prog, feed={"x": np.ones((2, 8), np.float32)},
+                    fetch_list=[h])
+    np.testing.assert_allclose(hv, 1.0)  # identity at inference
+
+
+def test_static_fetch_from_wrong_program_raises(static_mode):
+    p1 = paddle.static.Program()
+    with paddle.static.program_guard(p1):
+        x1 = paddle.static.data("x", [None, 2], "float32")
+        h1 = x1 * 2.0
+    p2 = paddle.static.Program()
+    with paddle.static.program_guard(p2):
+        paddle.static.data("x", [None, 2], "float32")
+    exe = paddle.static.Executor()
+    with pytest.raises(ValueError, match="not recorded"):
+        exe.run(p2, feed={"x": np.ones((1, 2), np.float32)},
+                fetch_list=[h1])
+
+
+def test_static_batch_norm_warns(static_mode):
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 4], "float32")
+        bn = nn.BatchNorm1D(4)
+        with pytest.warns(UserWarning, match="RUNNING statistics"):
+            bn(x)
+
+
 def test_data_requires_static_mode():
     assert paddle.in_dynamic_mode()
     with pytest.raises(RuntimeError, match="enable_static"):
