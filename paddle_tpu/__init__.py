@@ -42,6 +42,8 @@ from . import audio  # noqa: F401
 from . import hapi  # noqa: F401
 from . import incubate  # noqa: F401
 from . import geometric  # noqa: F401
+from . import onnx  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
 from . import callbacks  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .framework.param_attr import ParamAttr  # noqa: F401

@@ -1,0 +1,77 @@
+"""Dygraph data parallelism (reference: python/paddle/DataParallel +
+distributed/parallel.py init_parallel_env).
+
+TPU-native: under the single-controller runtime, dp normally rides the
+fused TrainStep / fleet engine (batch sharded P("dp"), XLA emits the grad
+all-reduce).  DataParallel exists for the reference's eager recipe —
+wrap the model, train eagerly, gradients are averaged across launch
+processes after backward.  With one process it is a transparent no-op.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..nn.layer import Layer
+from . import collective
+
+
+class DataParallel(Layer):
+    """Eager multi-process gradient averaging wrapper.
+
+    Usage (reference parity — the no_sync/fused_allreduce recipe):
+        model = paddle.DataParallel(model)
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        model.apply_collective_grads()   # average grads across processes
+        opt.step()
+
+    (The reference's reducer.cc does this automatically during backward;
+    here the averaging is one explicit XLA cross-process collective per
+    parameter, the same transport distributed.all_reduce uses.)
+    """
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self._group = group
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, sd, *a, **kw):
+        return self._layers.set_state_dict(sd, *a, **kw)
+
+    def scale_loss(self, loss):
+        """Reference keeps the API; loss scaling is a no-op here (grads
+        are averaged, not summed, in apply_collective_grads)."""
+        return loss
+
+    def apply_collective_grads(self):
+        """Average gradients across launch processes (no-op with one)."""
+        if jax.process_count() == 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                collective.all_reduce(p.grad, op=collective.ReduceOp.AVG,
+                                      group=self._group)
+
+    def no_sync(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            yield
+
+        return ctx()

@@ -16,10 +16,7 @@ import jax.numpy as jnp
 from .ops import call as _call
 from .ops.dispatch import register
 from .tensor import Tensor
-
-
-def _t(x):
-    return x if isinstance(x, Tensor) else Tensor(data=x)
+from .tensor_api import _t
 
 
 def _n_segments(segment_ids, out_size):
@@ -48,17 +45,26 @@ def _segment_mean_k(x, ids, n):
     return tot / jnp.maximum(cnt, 1).reshape(shape)
 
 
+def _empty_mask(x, ids, n):
+    """True for segments that received no elements (the reference emits 0
+    there; jax emits the dtype identity, which must not be confused with
+    legitimate +-inf data or integer extremes)."""
+    cnt = jax.ops.segment_sum(jnp.ones_like(ids, jnp.int32), ids,
+                              num_segments=n)
+    shape = (n,) + (1,) * (x.ndim - 1)
+    return (cnt == 0).reshape(shape)
+
+
 @register("segment_max", amp="keep")
 def _segment_max_k(x, ids, n):
     out = jax.ops.segment_max(x, ids, num_segments=n)
-    # empty segments: the reference emits 0, jax emits -inf
-    return jnp.where(jnp.isfinite(out), out, jnp.zeros_like(out))
+    return jnp.where(_empty_mask(x, ids, n), jnp.zeros_like(out), out)
 
 
 @register("segment_min", amp="keep")
 def _segment_min_k(x, ids, n):
     out = jax.ops.segment_min(x, ids, num_segments=n)
-    return jnp.where(jnp.isfinite(out), out, jnp.zeros_like(out))
+    return jnp.where(_empty_mask(x, ids, n), jnp.zeros_like(out), out)
 
 
 def segment_sum(data, segment_ids, name=None, out_size=None):
@@ -100,11 +106,6 @@ def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
     n = out_size if out_size is not None else x.shape[0]
     msg = _call("gather0", x, src_index)
     return _call(_REDUCERS[reduce_op], msg, dst_index, n=int(n))
-
-
-@register("mul", amp="keep")
-def _edge_mul_k(a, b):
-    return a * b
 
 
 _MSG_OPS = {"add": lambda a, b: a + b, "sub": lambda a, b: a - b,

@@ -71,3 +71,98 @@ def multi_dot(xs):
     for x in xs[1:]:
         out = out.matmul(x)
     return out
+
+
+def lu(x, pivot=True, get_infos=False):
+    """LU factorization (reference: paddle.linalg.lu): returns packed LU,
+    int32 pivots (1-based like the reference), and optionally an info
+    tensor (always 0 — XLA has no partial-failure reporting)."""
+    lu_packed, piv = ops.call("lu_factor", _t(x))
+    piv = piv + 1
+    if get_infos:
+        from . import tensor_api as T
+        return lu_packed, piv, T.zeros([1], dtype="int32")
+    return lu_packed, piv
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True):
+    """Unpack paddle.linalg.lu results into P, L, U (unbatched; the pivot
+    application is a host-side row-swap loop, matching the reference's
+    eager unpack)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from .tensor import Tensor
+    a = _t(lu_data)._array
+    m, n = a.shape[-2], a.shape[-1]
+    k = min(m, n)
+    # reference shape contract: L [m, k], U [k, n]
+    L = jnp.tril(a, -1)[..., :, :k] + jnp.eye(m, k, dtype=a.dtype)
+    U = jnp.triu(a)[..., :k, :]
+    piv = np.asarray(_t(lu_pivots)._array) - 1
+    if piv.ndim != 1:
+        raise NotImplementedError("lu_unpack supports unbatched inputs")
+    perm = np.arange(m)
+    for i, j in enumerate(piv):
+        perm[i], perm[j] = perm[j], perm[i]
+    P = jnp.eye(m, dtype=a.dtype)[perm].T
+    return (Tensor._from_array(P), Tensor._from_array(L),
+            Tensor._from_array(U))
+
+
+def cholesky_solve(x, y, upper=False):
+    """Solve A @ out = x given y = cholesky(A) (reference argument order:
+    x is the rhs, y the factor)."""
+    return ops.call("cholesky_solve", _t(x), _t(y), upper=upper)
+
+
+def matrix_exp(x):
+    return ops.call("matrix_exp", _t(x))
+
+
+def householder_product(x, tau):
+    return ops.call("householder_product", _t(x), _t(tau))
+
+
+def cond(x, p=None):
+    """Condition number (reference: paddle.linalg.cond). p in {None, 2,
+    -2, 'fro', 'nuc', 1, -1, inf, -inf}; None means 2-norm."""
+    from . import tensor_api as T
+    if p is None or p == 2 or p == -2:
+        s = svd(x, full_matrices=False)[1]
+        smax, smin = s.max(axis=-1), s.min(axis=-1)
+        return smax / smin if p != -2 else smin / smax
+    return norm(x, p=p) * norm(inv(x), p=p)
+
+
+def eig(x):
+    """General (non-symmetric) eigendecomposition.  XLA has no TPU/GPU
+    kernel for this (nor does the reference outside CPU); computed on host
+    via numpy and fed back as constants — eager-only, like the
+    reference's CPU-only eig."""
+    import numpy as np
+    from .tensor import Tensor
+    arr = _t(x)._array
+    import jax
+    if isinstance(arr, jax.core.Tracer):
+        raise NotImplementedError(
+            "linalg.eig is host-computed (no XLA kernel exists); call it "
+            "eagerly, outside jit")
+    w, v = np.linalg.eig(np.asarray(arr))
+    return Tensor._from_array(w), Tensor._from_array(v)
+
+
+def eigvals(x):
+    return eig(x)[0]
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return ops.call("cov_op", _t(x), rowvar=rowvar,
+                    ddof=1 if ddof else 0,
+                    fweights=None if fweights is None
+                    else _t(fweights)._array,
+                    aweights=None if aweights is None
+                    else _t(aweights)._array)
+
+
+def corrcoef(x, rowvar=True):
+    return ops.call("corrcoef_op", _t(x), rowvar=rowvar)

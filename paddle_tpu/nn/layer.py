@@ -36,7 +36,12 @@ class Layer:
         if isinstance(value, Tensor) and buffers is not None \
                 and name in buffers:
             # an existing buffer stays a buffer even when the new tensor is
-            # persistable (buffers are persistable by default)
+            # persistable (buffers are persistable by default), and the
+            # replacement inherits the slot's persistable marking so
+            # static-graph leaf capture keeps seeing it as live state
+            if name not in self.__dict__.get(
+                    "_non_persistable_buffer_names", ()):
+                value.persistable = True
             buffers[name] = value
         elif isinstance(value, Tensor) and (
                 not value.stop_gradient or getattr(value, "persistable",

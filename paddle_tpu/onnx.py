@@ -1,0 +1,26 @@
+"""paddle.onnx analog (reference: python/paddle/onnx/export.py, which
+delegates to the paddle2onnx package).
+
+This environment has no onnx runtime/converter; the honest TPU-native
+export path is StableHLO (`paddle_tpu.jit.save` / `paddle_tpu.static.
+save_inference_model`), which XLA consumers load directly.  `export`
+therefore raises with that guidance unless the optional `onnx` package is
+importable, in which case exporting via StableHLO→ONNX would need a
+converter that this offline image does not ship.
+"""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise NotImplementedError(
+            "ONNX export requires the 'onnx'/'paddle2onnx' packages, which "
+            "this offline environment does not provide. Use "
+            "paddle_tpu.jit.save(layer, path, input_spec) for a portable "
+            "StableHLO program (loadable by any XLA consumer), or "
+            "paddle_tpu.static.save_inference_model for static graphs.")
+    raise NotImplementedError(
+        "StableHLO→ONNX conversion is not shipped; export via "
+        "paddle_tpu.jit.save (StableHLO) instead.")

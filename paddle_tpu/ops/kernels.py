@@ -408,6 +408,18 @@ register("triangular_solve", lambda a, b, upper=True, transpose=False,
 register("trace_op", lambda x, offset=0, axis1=0, axis2=1:
          jnp.trace(x, offset, axis1, axis2))
 register("matrix_rank", lambda x, tol=None: jnp.linalg.matrix_rank(x, tol=tol))
+register("lu_factor", lambda x: tuple(jax.scipy.linalg.lu_factor(x)))
+register("lu_full", lambda x: tuple(jax.scipy.linalg.lu(x)))
+register("cholesky_solve", lambda b, chol, upper=False:
+         jax.scipy.linalg.cho_solve((chol, not upper), b))
+register("matrix_exp", lambda x: jax.scipy.linalg.expm(x))
+register("householder_product", lambda x, tau:
+         jax.lax.linalg.householder_product(x, tau))
+register("cov_op", lambda x, rowvar=True, ddof=1, fweights=None,
+         aweights=None: jnp.cov(x, rowvar=rowvar, ddof=ddof,
+                                fweights=fweights, aweights=aweights))
+register("corrcoef_op", lambda x, rowvar=True:
+         jnp.corrcoef(x, rowvar=rowvar))
 
 # -------------------------------------------------------------- activations
 register("softmax", lambda x, axis=-1: jax.nn.softmax(x, axis=axis),

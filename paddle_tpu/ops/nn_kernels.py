@@ -462,8 +462,6 @@ def fold_k(x, output_sizes, kernel_sizes, strides=1, paddings=0,
            dilations=1):
     """col2im — inverse of unfold (reference: paddle.nn.functional.fold).
     x [N, C*kh*kw, L] -> [N, C, H, W] with overlapping patches summed."""
-    def _pair(v):
-        return (v, v) if isinstance(v, int) else tuple(v)
     H, W = _pair(output_sizes)
     kh, kw = _pair(kernel_sizes)
     sh, sw = _pair(strides)

@@ -233,3 +233,139 @@ class Adafactor(Optimizer):
             update = m
         scale = jnp.maximum(self._eps2, jnp.sqrt(jnp.mean(jnp.square(p))))
         return p - lr * scale * update, slots
+
+
+class NAdam(Optimizer):
+    """reference: python/paddle/optimizer/nadam.py (Nesterov-momentum
+    Adam; mu-product schedule per Dozat 2016)."""
+
+    SLOTS = ("moment1", "moment2", "mu_product")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         **kw)
+        self._b1, self._b2 = beta1, beta2
+        self._eps = epsilon
+        self._psi = momentum_decay
+
+    def _init_state_for(self, arr):
+        return {"moment1": jnp.zeros_like(arr, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(arr, dtype=jnp.float32),
+                "mu_product": jnp.ones((), jnp.float32)}
+
+    def _rule(self, g, p, slots, lr, step):
+        b1, b2 = self._b1, self._b2
+        g32 = g.astype(jnp.float32)
+        mu_t = b1 * (1.0 - 0.5 * 0.96 ** (step * self._psi))
+        mu_t1 = b1 * (1.0 - 0.5 * 0.96 ** ((step + 1.0) * self._psi))
+        mu_prod = slots["mu_product"] * mu_t
+        m = b1 * slots["moment1"] + (1.0 - b1) * g32
+        v = b2 * slots["moment2"] + (1.0 - b2) * jnp.square(g32)
+        m_hat = (mu_t1 * m / (1.0 - mu_prod * mu_t1)
+                 + (1.0 - mu_t) * g32 / (1.0 - mu_prod))
+        v_hat = v / (1.0 - b2 ** step)
+        slots["moment1"], slots["moment2"] = m, v
+        slots["mu_product"] = mu_prod
+        upd = lr * m_hat / (jnp.sqrt(v_hat) + self._eps)
+        return p - upd.astype(p.dtype), slots
+
+
+class RAdam(Optimizer):
+    """reference: python/paddle/optimizer/radam.py (rectified Adam —
+    variance-rectification warmup, Liu et al. 2020)."""
+
+    SLOTS = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         **kw)
+        self._b1, self._b2 = beta1, beta2
+        self._eps = epsilon
+
+    def _rule(self, g, p, slots, lr, step):
+        b1, b2 = self._b1, self._b2
+        g32 = g.astype(jnp.float32)
+        m = b1 * slots["moment1"] + (1.0 - b1) * g32
+        v = b2 * slots["moment2"] + (1.0 - b2) * jnp.square(g32)
+        slots["moment1"], slots["moment2"] = m, v
+        m_hat = m / (1.0 - b1 ** step)
+        rho_inf = 2.0 / (1.0 - b2) - 1.0
+        beta2_t = b2 ** step
+        rho_t = rho_inf - 2.0 * step * beta2_t / (1.0 - beta2_t)
+        # rectified update when variance is tractable (rho_t > 5, the
+        # torch/reference convention), un-adapted momentum otherwise —
+        # branchless for XLA
+        r = jnp.sqrt(jnp.clip(
+            (rho_t - 4.0) * (rho_t - 2.0) * rho_inf
+            / jnp.clip((rho_inf - 4.0) * (rho_inf - 2.0) * rho_t,
+                       1e-9, None), 0.0, None))
+        v_hat = jnp.sqrt(v / (1.0 - beta2_t)) + self._eps
+        adaptive = lr * r * m_hat / v_hat
+        plain = lr * m_hat
+        upd = jnp.where(rho_t > 5.0, adaptive, plain)
+        return p - upd.astype(p.dtype), slots
+
+
+class ASGD(Optimizer):
+    """reference: python/paddle/optimizer/asgd.py (averaged SGD): keeps a
+    running average of the iterates in the "averaged" slot; the averaged
+    weights are what Polyak averaging would deploy."""
+
+    SLOTS = ("averaged",)
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         **kw)
+        self._batch_num = batch_num
+
+    def _init_state_for(self, arr):
+        # explicit copy: sharing the param's buffer would make the jitted
+        # step donate the same buffer twice (params and state both donate)
+        return {"averaged": jnp.array(arr, dtype=jnp.float32, copy=True)}
+
+    def _rule(self, g, p, slots, lr, step):
+        p2 = p - lr * g
+        avg = slots["averaged"] + (p2.astype(jnp.float32)
+                                   - slots["averaged"]) / step
+        slots["averaged"] = avg
+        return p2, slots
+
+
+class Rprop(Optimizer):
+    """reference: python/paddle/optimizer/rprop.py (resilient
+    backpropagation — sign-based per-weight step sizes)."""
+
+    SLOTS = ("prev_grad", "learning_rate")
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 weight_decay=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         **kw)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_minus, self._eta_plus = etas
+
+    def _init_state_for(self, arr):
+        return {"prev_grad": jnp.zeros_like(arr, dtype=jnp.float32),
+                "learning_rate": jnp.full_like(
+                    arr, float(self._lr
+                               if isinstance(self._lr, (int, float))
+                               else 0.001), dtype=jnp.float32)}
+
+    def _rule(self, g, p, slots, lr, step):
+        g32 = g.astype(jnp.float32)
+        sign = jnp.sign(g32 * slots["prev_grad"])
+        scale = jnp.where(sign > 0, self._eta_plus,
+                          jnp.where(sign < 0, self._eta_minus, 1.0))
+        step_size = jnp.clip(slots["learning_rate"] * scale,
+                             self._lr_min, self._lr_max)
+        # on sign change: zero the step for this weight this round
+        g_eff = jnp.where(sign < 0, 0.0, g32)
+        slots["prev_grad"] = g_eff
+        slots["learning_rate"] = step_size
+        return p - (step_size * jnp.sign(g_eff)).astype(p.dtype), slots

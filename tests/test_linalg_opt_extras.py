@@ -1,0 +1,110 @@
+"""Round-2 linalg/optimizer/sampler additions vs numpy/scipy/torch."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as pt
+from paddle_tpu import linalg as L
+
+
+def _spd(n, seed=0):
+    rng = np.random.RandomState(seed)
+    a = rng.randn(n, n).astype(np.float32)
+    return a @ a.T + n * np.eye(n, dtype=np.float32)
+
+
+def test_lu_roundtrip():
+    rng = np.random.RandomState(0)
+    a = rng.randn(5, 5).astype(np.float32)
+    lu_packed, piv = L.lu(pt.to_tensor(a))
+    P, Lm, U = L.lu_unpack(lu_packed, piv)
+    recon = P.numpy() @ Lm.numpy() @ U.numpy()
+    np.testing.assert_allclose(recon, a, rtol=1e-4, atol=1e-4)
+
+
+def test_cholesky_solve():
+    a = _spd(4)
+    b = np.random.RandomState(1).randn(4, 2).astype(np.float32)
+    c = np.linalg.cholesky(a).astype(np.float32)
+    got = L.cholesky_solve(pt.to_tensor(b), pt.to_tensor(c)).numpy()
+    np.testing.assert_allclose(a @ got, b, rtol=1e-3, atol=1e-3)
+
+
+def test_matrix_exp():
+    a = np.random.RandomState(2).randn(4, 4).astype(np.float32) * 0.3
+    got = L.matrix_exp(pt.to_tensor(a)).numpy()
+    want = torch.matrix_exp(torch.tensor(a)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_cond_and_eig():
+    a = _spd(4, seed=3)
+    got = float(L.cond(pt.to_tensor(a)))
+    want = float(np.linalg.cond(a))
+    assert abs(got - want) / want < 1e-3
+    w, v = L.eig(pt.to_tensor(a))
+    wn = np.sort(np.real(w.numpy()))
+    np.testing.assert_allclose(wn, np.sort(np.linalg.eigvalsh(a)),
+                               rtol=1e-3)
+
+
+def test_cov_corrcoef():
+    x = np.random.RandomState(4).randn(3, 50).astype(np.float32)
+    np.testing.assert_allclose(L.cov(pt.to_tensor(x)).numpy(),
+                               np.cov(x), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(L.corrcoef(pt.to_tensor(x)).numpy(),
+                               np.corrcoef(x), rtol=1e-4, atol=1e-5)
+
+
+def test_householder_product_reconstructs_q():
+    a = np.random.RandomState(5).randn(6, 4).astype(np.float32)
+    import scipy.linalg as sl
+    h, tau = sl.qr(a, mode="raw")[0]   # LAPACK geqrf output
+    q = L.householder_product(pt.to_tensor(np.ascontiguousarray(h)),
+                              pt.to_tensor(np.ascontiguousarray(tau)))
+    q_want, _ = np.linalg.qr(a)
+    np.testing.assert_allclose(np.abs(q.numpy()[:, :4]), np.abs(q_want),
+                               rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("cls", ["NAdam", "RAdam", "ASGD", "Rprop"])
+def test_new_optimizers_converge_on_quadratic(cls):
+    pt.seed(0)
+    w = pt.to_tensor(np.array([3.0, -2.0], np.float32))
+    w.stop_gradient = False
+    opt = getattr(pt.optimizer, cls)(learning_rate=0.1, parameters=[w])
+    for _ in range(150):
+        loss = (w ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float((w ** 2).sum()) < 1e-2, (cls, w.numpy())
+
+
+def test_nadam_radam_match_torch_few_steps():
+    for name, tcls in [("NAdam", torch.optim.NAdam),
+                       ("RAdam", torch.optim.RAdam)]:
+        w0 = np.array([1.0, -2.0, 0.5], np.float32)
+        wp = pt.to_tensor(w0.copy()); wp.stop_gradient = False
+        wt = torch.tensor(w0.copy(), requires_grad=True)
+        po = getattr(pt.optimizer, name)(learning_rate=0.01,
+                                         parameters=[wp])
+        to = tcls([wt], lr=0.01)
+        for _ in range(5):
+            lp = (wp ** 2).sum(); lp.backward(); po.step(); po.clear_grad()
+            to.zero_grad(); lt = (wt ** 2).sum(); lt.backward(); to.step()
+        np.testing.assert_allclose(wp.numpy(), wt.detach().numpy(),
+                                   rtol=2e-3, atol=2e-4), name
+
+
+def test_weighted_and_subset_samplers():
+    from paddle_tpu.io import WeightedRandomSampler, SubsetRandomSampler
+    np.random.seed(0)
+    s = WeightedRandomSampler([0.0, 0.0, 1.0, 1.0], num_samples=200)
+    idx = list(s)
+    assert len(idx) == 200 and set(idx) <= {2, 3}
+    sub = SubsetRandomSampler([5, 7, 9])
+    out = list(sub)
+    assert sorted(out) == [5, 7, 9]
+    with pytest.raises(ValueError):
+        WeightedRandomSampler([-1.0, 2.0], 2)

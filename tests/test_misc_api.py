@@ -1,0 +1,30 @@
+"""DataParallel wrapper + onnx export guidance (reference API surface)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+
+
+def test_data_parallel_transparent_single_process():
+    pt.seed(0)
+    inner = nn.Linear(4, 2)
+    model = pt.DataParallel(inner)
+    x = pt.randn([3, 4])
+    np.testing.assert_allclose(model(x).numpy(), inner(x).numpy())
+    loss = model.scale_loss((model(x) ** 2).mean())
+    loss.backward()
+    model.apply_collective_grads()   # no-op with one process
+    assert inner.weight.grad is not None
+    with model.no_sync():
+        pass
+    # state dict passthrough + attribute delegation
+    sd = model.state_dict()
+    assert "weight" in sd
+    assert model.weight is inner.weight
+
+
+def test_onnx_export_points_to_stablehlo():
+    m = nn.Linear(2, 2)
+    with pytest.raises(NotImplementedError, match="StableHLO"):
+        pt.onnx.export(m, "/tmp/never")
