@@ -10,8 +10,10 @@ from .dtypes import (  # noqa: F401
     bool_ as bool8, complex64, complex128,
     set_default_dtype, get_default_dtype, finfo, iinfo,
 )
+from . import device  # noqa: F401
 from .device import (  # noqa: F401
     set_device, get_device, is_compiled_with_tpu, device_count,
+    is_compiled_with_cuda, is_compiled_with_xpu,
     TPUPlace, CPUPlace, Place,
 )
 from .tensor import Tensor, parameter  # noqa: F401
@@ -43,6 +45,7 @@ from . import hapi  # noqa: F401
 from . import incubate  # noqa: F401
 from . import geometric  # noqa: F401
 from . import onnx  # noqa: F401
+from . import inference  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
 from . import callbacks  # noqa: F401
 from .hapi import Model  # noqa: F401

@@ -85,5 +85,79 @@ def is_compiled_with_tpu() -> bool:
     return any(_kind(d) == "tpu" for d in jax.devices())
 
 
+def is_compiled_with_cuda() -> bool:
+    return False  # TPU-native build (reference parity shim)
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
 def device_count() -> int:
     return len(jax.devices())
+
+
+# ------------------------------------------------------- cuda-compat shims
+class _CudaNamespace:
+    """paddle.device.cuda compatibility (reference: python/paddle/device/
+    cuda/__init__.py).  Ported user code calls these around training
+    loops; on the XLA runtime memory is pool-managed and dispatch is
+    async by design, so the knobs are truthful no-ops / TPU remaps."""
+
+    @staticmethod
+    def device_count():
+        import jax
+        return len([d for d in jax.devices() if d.platform != "cpu"])
+
+    @staticmethod
+    def empty_cache():
+        pass  # XLA BFC allocator owns the pool
+
+    @staticmethod
+    def synchronize(device=None):
+        import jax
+        import jax.numpy as jnp
+        jax.block_until_ready(jnp.zeros(()))
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        import jax
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            return int(stats.get("peak_bytes_in_use", 0))
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        import jax
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            return int(stats.get("bytes_in_use", 0))
+        except Exception:
+            return 0
+
+    @staticmethod
+    def get_device_name(device=None):
+        import jax
+        return jax.devices()[0].device_kind
+
+    class Stream:
+        """Streams do not exist on the XLA runtime (dispatch is async,
+        ordering is data-flow); kept for API-compatible construction."""
+
+        def __init__(self, *a, **kw):
+            pass
+
+    class Event:
+        def __init__(self, *a, **kw):
+            pass
+
+        def record(self, *a, **kw):
+            pass
+
+        def synchronize(self):
+            _CudaNamespace.synchronize()
+
+
+cuda = _CudaNamespace()

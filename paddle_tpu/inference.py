@@ -1,0 +1,149 @@
+"""Paddle Inference deployment API analog (reference: paddle/fluid/inference
++ python/paddle/inference — Config / create_predictor / PredictorTensor
+handles over a serialized inference program).
+
+TPU-native: the serialized artifact is the StableHLO export produced by
+`paddle_tpu.jit.save` / `paddle_tpu.static.save_inference_model`; the
+predictor replays it through jax (XLA does the CINN-style fusion the
+reference's IR passes performed).  The reference's hardware/IR tuning knobs
+are accepted and recorded but are no-ops — XLA owns those decisions here.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+class Config:
+    """reference: paddle.inference.Config(model_dir) — accepts either a
+    jit.save directory or a static.save_inference_model directory."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        self._dir = prog_file if prog_file is not None else ""
+        self._params_file = params_file
+        self._use_gpu = False
+        self._memory_optim = False
+        self._ir_optim = True
+        self._cpu_threads = 1
+
+    # knob surface (recorded; XLA owns the actual decisions)
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_gpu = True
+
+    def disable_gpu(self):
+        self._use_gpu = False
+
+    def enable_memory_optim(self):
+        self._memory_optim = True
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_threads = n
+
+    def model_dir(self):
+        return self._dir
+
+    def disable_glog_info(self):
+        pass
+
+    def enable_mkldnn(self):
+        pass
+
+
+class _Handle:
+    """Input/output tensor handle (reference: PaddleInferTensor)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._array = None
+
+    def copy_from_cpu(self, arr):
+        self._array = np.asarray(arr)
+
+    def copy_to_cpu(self):
+        return self._array
+
+    def reshape(self, shape):
+        pass  # shapes come from the fed array
+
+    def shape(self):
+        return None if self._array is None else list(self._array.shape)
+
+
+class Predictor:
+    def __init__(self, config):
+        path = config.model_dir()
+        if os.path.exists(os.path.join(path, "static_model.stablehlo")):
+            from .static import load_inference_model
+            prog, feed_names, fetch_targets = load_inference_model(path)
+            self._call = lambda arrays: prog._loaded_call(
+                dict(zip(feed_names, arrays)), fetch_targets,
+                return_numpy=True)
+            self._input_names = list(feed_names)
+            self._n_out = len(fetch_targets)
+        else:
+            from .jit.save_load import load_inference
+            layer = load_inference(path)
+            spec = layer._meta.get("input_spec", [])
+            self._input_names = [
+                s.get("name") or f"input_{i}"
+                for i, s in enumerate(spec)] or ["input_0"]
+
+            def call(arrays):
+                out = layer(*arrays)
+                outs = out if isinstance(out, (tuple, list)) else [out]
+                return [np.asarray(o.numpy() if hasattr(o, "numpy") else o)
+                        for o in outs]
+
+            self._call = call
+            try:  # StableHLO signature knows the output arity up front
+                self._n_out = len(layer._exported.out_avals)
+            except Exception:
+                self._n_out = None  # discovered at first run
+        self._inputs = {n: _Handle(n) for n in self._input_names}
+        self._out_handles = {}
+        self._outputs = None
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def run(self):
+        arrays = []
+        for n in self._input_names:
+            h = self._inputs[n]
+            if h._array is None:
+                raise ValueError(f"input {n!r} was not fed "
+                                 "(copy_from_cpu first)")
+            arrays.append(h._array)
+        outs = self._call(arrays)
+        self._outputs = [np.asarray(o) for o in outs]
+        self._n_out = len(self._outputs)
+        # refresh live handles (handles fetched before run() see results)
+        for name, h in self._out_handles.items():
+            h._array = self._outputs[int(name.rsplit("_", 1)[1])]
+        return True
+
+    def get_output_names(self):
+        n = self._n_out if self._n_out is not None else \
+            (len(self._outputs) if self._outputs else 0)
+        return [f"output_{i}" for i in range(n)]
+
+    def get_output_handle(self, name):
+        # handles are LIVE views: kept and refreshed on every run()
+        h = self._out_handles.get(name)
+        if h is None:
+            h = _Handle(name)
+            self._out_handles[name] = h
+        if self._outputs is not None:
+            h._array = self._outputs[int(name.rsplit("_", 1)[1])]
+        return h
+
+
+def create_predictor(config):
+    return Predictor(config)

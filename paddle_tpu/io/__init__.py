@@ -131,6 +131,10 @@ class WeightedRandomSampler(Sampler):
         self.weights = np.asarray(weights, dtype=np.float64)
         if (self.weights < 0).any():
             raise ValueError("weights must be non-negative")
+        if self.weights.sum() <= 0:
+            raise ValueError("weights must sum to a positive value")
+        if num_samples <= 0:
+            raise ValueError("num_samples must be positive")
         self.num_samples = num_samples
         self.replacement = replacement
         if not replacement and num_samples > len(self.weights):

@@ -36,16 +36,20 @@ class Layer:
         if isinstance(value, Tensor) and buffers is not None \
                 and name in buffers:
             # an existing buffer stays a buffer even when the new tensor is
-            # persistable (buffers are persistable by default), and the
-            # replacement inherits the slot's persistable marking so
-            # static-graph leaf capture keeps seeing it as live state
+            # persistable; the replacement inherits the slot's buffer role
+            # + persistable marking so static-graph leaf capture keeps
+            # seeing it as live state
+            value._is_buffer = True
             if name not in self.__dict__.get(
                     "_non_persistable_buffer_names", ()):
                 value.persistable = True
             buffers[name] = value
         elif isinstance(value, Tensor) and (
-                not value.stop_gradient or getattr(value, "persistable",
-                                                   False)):
+                not value.stop_gradient or (
+                    getattr(value, "persistable", False)
+                    and not getattr(value, "_is_buffer", False))):
+            # persistable + _is_buffer tensors are buffer state, not frozen
+            # parameters — they must not enter _parameters of ANY layer
             # persistable covers frozen params (ParamAttr(trainable=False)):
             # they must stay in _parameters/state_dict even though they
             # take no gradient
@@ -116,6 +120,7 @@ class Layer:
 
     def register_buffer(self, name, tensor, persistable=True):
         self._buffers[name] = tensor
+        tensor._is_buffer = True
         if not persistable:
             self._non_persistable_buffer_names.add(name)
         else:

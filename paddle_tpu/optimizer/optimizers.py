@@ -1,6 +1,7 @@
 """Concrete optimizers (reference: python/paddle/optimizer/{sgd,adam,...}.py)."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from .optimizer import Optimizer
@@ -311,29 +312,37 @@ class RAdam(Optimizer):
 
 
 class ASGD(Optimizer):
-    """reference: python/paddle/optimizer/asgd.py (averaged SGD): keeps a
-    running average of the iterates in the "averaged" slot; the averaged
-    weights are what Polyak averaging would deploy."""
+    """reference: python/paddle/optimizer/asgd.py — each step applies the
+    AVERAGE of the last `batch_num` gradients: a circular per-param grad
+    buffer feeds d += g - buffer[idx]; p -= lr * d / min(step, m).
+    batch_num=1 degenerates to SGD exactly.  Note the buffer costs
+    batch_num copies of every parameter, as in the reference."""
 
-    SLOTS = ("averaged",)
+    SLOTS = ("d", "grad_buffer")
 
     def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
                  weight_decay=None, grad_clip=None, **kw):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          **kw)
-        self._batch_num = batch_num
+        self._batch_num = int(batch_num)
 
     def _init_state_for(self, arr):
-        # explicit copy: sharing the param's buffer would make the jitted
-        # step donate the same buffer twice (params and state both donate)
-        return {"averaged": jnp.array(arr, dtype=jnp.float32, copy=True)}
+        return {"d": jnp.zeros_like(arr, dtype=jnp.float32),
+                "grad_buffer": jnp.zeros((self._batch_num,) + arr.shape,
+                                         jnp.float32)}
 
     def _rule(self, g, p, slots, lr, step):
-        p2 = p - lr * g
-        avg = slots["averaged"] + (p2.astype(jnp.float32)
-                                   - slots["averaged"]) / step
-        slots["averaged"] = avg
-        return p2, slots
+        m = self._batch_num
+        g32 = g.astype(jnp.float32)
+        idx = (step.astype(jnp.int32) - 1) % m
+        old = jax.lax.dynamic_index_in_dim(slots["grad_buffer"], idx, 0,
+                                           keepdims=False)
+        d = slots["d"] + g32 - old
+        slots["d"] = d
+        slots["grad_buffer"] = jax.lax.dynamic_update_index_in_dim(
+            slots["grad_buffer"], g32, idx, 0)
+        denom = jnp.minimum(step, float(m))
+        return p - (lr * d / denom).astype(p.dtype), slots
 
 
 class Rprop(Optimizer):

@@ -38,7 +38,7 @@ def _coerce(data, dtype=None):
 class Tensor:
     __slots__ = ("_array", "stop_gradient", "grad", "_node", "_out_index",
                  "_retain_grads", "name", "persistable", "pspec",
-                 "optimize_attr", "_sym", "__weakref__")
+                 "optimize_attr", "_sym", "_is_buffer", "__weakref__")
 
     def __init__(self, data=None, dtype=None, place=None, stop_gradient=True,
                  name=None):

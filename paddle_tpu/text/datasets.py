@@ -1,0 +1,54 @@
+"""paddle.text.datasets analog (reference: python/paddle/text/datasets/*
+— Imdb, Conll05st, Movielens, UCIHousing, WMT14/16, ...).
+
+This image has zero network egress, so the downloadable corpora cannot be
+fetched; like vision/datasets.py, the named classes exist with the
+reference constructor surface and raise with clear guidance, and a
+FakeTextDataset provides deterministic synthetic data for pipelines/tests.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class FakeTextDataset(Dataset):
+    """Deterministic synthetic (ids, label) pairs standing in for the
+    downloadable corpora."""
+
+    def __init__(self, num_samples=1000, seq_len=64, vocab_size=1000,
+                 num_classes=2, seed=0):
+        self.num_samples = num_samples
+        rng = np.random.RandomState(seed)
+        self.ids = rng.randint(0, vocab_size,
+                               (num_samples, seq_len)).astype(np.int32)
+        self.labels = rng.randint(0, num_classes,
+                                  (num_samples,)).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.ids[i], self.labels[i]
+
+    def __len__(self):
+        return self.num_samples
+
+
+def _offline(name):
+    class _Stub(Dataset):
+        def __init__(self, *a, **kw):
+            raise NotImplementedError(
+                f"{name}: corpus download is unavailable in this offline "
+                "environment; use paddle_tpu.text.datasets.FakeTextDataset "
+                "or point your own Dataset at local files")
+
+    _Stub.__name__ = name
+    return _Stub
+
+
+Imdb = _offline("Imdb")
+Conll05st = _offline("Conll05st")
+Movielens = _offline("Movielens")
+UCIHousing = _offline("UCIHousing")
+WMT14 = _offline("WMT14")
+WMT16 = _offline("WMT16")
+ViterbiDecoder = _offline("ViterbiDecoder")

@@ -1,0 +1,86 @@
+"""Paddle Inference deployment API over the StableHLO artifacts."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.framework import static_graph as SG
+
+
+def test_predictor_over_static_export(tmp_path):
+    paddle.enable_static()
+    SG.reset()
+    try:
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [None, 4], "float32")
+            model = nn.Linear(4, 3)
+            pred = F.softmax(model(x))
+        exe = paddle.static.Executor()
+        feed = {"x": np.ones((2, 4), np.float32)}
+        (want,) = exe.run(main, feed=feed, fetch_list=[pred])
+        path = os.path.join(str(tmp_path), "deploy")
+        with paddle.static.program_guard(main):
+            paddle.static.save_inference_model(path, [x], [pred], exe)
+    finally:
+        SG.reset()
+        paddle.disable_static()
+
+    config = paddle.inference.Config(path)
+    config.enable_memory_optim()
+    predictor = paddle.inference.create_predictor(config)
+    assert predictor.get_input_names() == ["x"]
+    h = predictor.get_input_handle("x")
+    h.copy_from_cpu(np.ones((2, 4), np.float32))
+    assert predictor.run()
+    out = predictor.get_output_handle(
+        predictor.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_predictor_over_jit_save(tmp_path):
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model.eval()
+    x = paddle.randn([2, 4])
+    want = model(x).numpy()
+    path = os.path.join(str(tmp_path), "jitdeploy")
+    from paddle_tpu.jit.save_load import InputSpec, save_inference
+    save_inference(model, path, [InputSpec([None, 4], "float32", "x")])
+
+    predictor = paddle.inference.create_predictor(
+        paddle.inference.Config(path))
+    # canonical recipe: output names/handles are valid BEFORE run()
+    out_names = predictor.get_output_names()
+    assert out_names == ["output_0"]
+    pre_handle = predictor.get_output_handle(out_names[0])
+    h = predictor.get_input_handle(predictor.get_input_names()[0])
+    h.copy_from_cpu(x.numpy())
+    predictor.run()
+    np.testing.assert_allclose(pre_handle.copy_to_cpu(), want,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_missing_feed_raises(tmp_path):
+    paddle.seed(0)
+    model = nn.Linear(2, 2)
+    model.eval()
+    path = os.path.join(str(tmp_path), "m")
+    from paddle_tpu.jit.save_load import InputSpec, save_inference
+    save_inference(model, path, [InputSpec([None, 2], "float32", "x")])
+    predictor = paddle.inference.create_predictor(
+        paddle.inference.Config(path))
+    with pytest.raises(ValueError, match="not fed"):
+        predictor.run()
+
+
+def test_text_datasets_surface():
+    from paddle_tpu.text import datasets as D
+    ds = D.FakeTextDataset(num_samples=10, seq_len=8)
+    ids, label = ds[0]
+    assert ids.shape == (8,) and len(ds) == 10
+    with pytest.raises(NotImplementedError, match="offline"):
+        D.Imdb()
