@@ -19,21 +19,27 @@ _SO = os.path.join(_DIR, "_ring.so")
 LIB = None
 
 
-def _build(force=False):
-    if (not force and os.path.exists(_SO)
-            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
-        return _SO
+def build_so(src, so, force=False):
+    """Compile `src` → `so` with g++ if stale (atomic publish; safe under
+    concurrent importers)."""
+    if (not force and os.path.exists(so)
+            and os.path.getmtime(so) >= os.path.getmtime(src)):
+        return so
     import tempfile
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)  # unique per process:
     os.close(fd)                                        # concurrent builds
     try:                                                # publish atomically
-        cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC]
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, src]
         subprocess.run(cmd, check=True, capture_output=True)
-        os.replace(tmp, _SO)
+        os.replace(tmp, so)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
-    return _SO
+    return so
+
+
+def _build(force=False):
+    return build_so(_SRC, _SO, force=force)
 
 
 def _bind(path):

@@ -1,0 +1,82 @@
+"""ctypes binding for the native image-pipeline kernels (imgproc.cc).
+
+`to_chw_f32(img_u8_hwc, mean, std, unit_scale)` fuses uint8→float32,
+/255 + normalize, and HWC→CHW into ONE C pass — the Python pipeline's
+three numpy passes collapse (this loop is the host-side bottleneck that
+feeds the device).  Unavailable toolchain degrades to `available() ==
+False` and callers fall back to numpy.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from . import build_so
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "imgproc.cc")
+_SO = os.path.join(_DIR, "_imgproc.so")
+
+LIB = None
+
+
+def _bind(path):
+    lib = ctypes.CDLL(path)
+    fp = ctypes.POINTER(ctypes.c_float)
+    lib.hwc_u8_to_chw_f32.argtypes = [
+        ctypes.c_char_p, fp, ctypes.c_long, ctypes.c_long, ctypes.c_long,
+        fp, fp, ctypes.c_int]
+    lib.batch_hwc_u8_to_chw_f32.argtypes = [
+        ctypes.c_char_p, fp, ctypes.c_long, ctypes.c_long, ctypes.c_long,
+        ctypes.c_long, fp, fp, ctypes.c_int]
+    return lib
+
+
+try:
+    LIB = _bind(build_so(_SRC, _SO))
+except OSError:
+    try:
+        LIB = _bind(build_so(_SRC, _SO, force=True))
+    except Exception:  # pragma: no cover - toolchain missing
+        LIB = None
+except Exception:  # pragma: no cover - toolchain missing
+    LIB = None
+
+
+def available():
+    return LIB is not None
+
+
+def _fptr(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def to_chw_f32(img, mean=None, std=None, unit_scale=True):
+    """img: uint8 HWC (or batched NHWC) contiguous → float32 CHW/NCHW,
+    optionally normalized.  Caller guarantees availability."""
+    img = np.ascontiguousarray(img)
+    assert img.dtype == np.uint8 and img.ndim in (3, 4)
+    m = iv = None
+    c = img.shape[-1]
+    if mean is not None:
+        m = np.ascontiguousarray(np.broadcast_to(
+            np.asarray(mean, np.float32), (c,)))
+        iv = np.ascontiguousarray(
+            1.0 / np.broadcast_to(np.asarray(std, np.float32), (c,)))
+    if img.ndim == 3:
+        h, w, _ = img.shape
+        out = np.empty((c, h, w), np.float32)
+        LIB.hwc_u8_to_chw_f32(
+            img.ctypes.data_as(ctypes.c_char_p), _fptr(out), h, w, c,
+            None if m is None else _fptr(m),
+            None if iv is None else _fptr(iv), int(unit_scale))
+    else:
+        n, h, w, _ = img.shape
+        out = np.empty((n, c, h, w), np.float32)
+        LIB.batch_hwc_u8_to_chw_f32(
+            img.ctypes.data_as(ctypes.c_char_p), _fptr(out), n, h, w, c,
+            None if m is None else _fptr(m),
+            None if iv is None else _fptr(iv), int(unit_scale))
+    return out

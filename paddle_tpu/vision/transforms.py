@@ -13,8 +13,30 @@ from ..tensor import Tensor
 
 
 class Compose:
+    """Chains transforms; an adjacent [ToTensor(CHW), Normalize(CHW)] pair
+    is fused into ONE native C pass (io/native/imgproc.cc) when the input
+    is a uint8 HWC image — uint8→f32, /255+normalize, and the HWC→CHW
+    transpose collapse into a single loop (the reference's C++ DataLoader
+    workers do this preprocessing natively too).  Falls back to the
+    original two numpy transforms for any other input."""
+
     def __init__(self, transforms):
-        self.transforms = transforms
+        self.transforms = self._fuse(list(transforms))
+
+    @staticmethod
+    def _fuse(ts):
+        out, i = [], 0
+        while i < len(ts):
+            t, nxt = ts[i], ts[i + 1] if i + 1 < len(ts) else None
+            if (isinstance(t, ToTensor) and t.data_format == "CHW"
+                    and isinstance(nxt, Normalize)
+                    and nxt.data_format == "CHW"):
+                out.append(_FusedToTensorNormalize(t, nxt))
+                i += 2
+            else:
+                out.append(t)
+                i += 1
+        return out
 
     def __call__(self, img):
         for t in self.transforms:
@@ -162,3 +184,208 @@ class Transpose(BaseTransform):
 
     def __call__(self, img):
         return _hwc(img).transpose(self.order)
+
+
+class Pad(BaseTransform):
+    """reference: paddle.vision.transforms.Pad (constant/edge/reflect)."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding = [padding] * 4 if isinstance(padding, int) else \
+            list(padding)
+        if len(self.padding) == 2:
+            self.padding = [self.padding[0], self.padding[1]] * 2
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def __call__(self, img):
+        arr = _hwc(img)
+        l, t, r, b = self.padding
+        pads = [(t, b), (l, r)] + ([(0, 0)] if arr.ndim == 3 else [])
+        if self.padding_mode == "constant":
+            return np.pad(arr, pads, constant_values=self.fill)
+        return np.pad(arr, pads, mode=self.padding_mode)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, img):
+        arr = _hwc(img).astype(np.float32)
+        if arr.ndim == 2:
+            g = arr
+        else:
+            g = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+                 + 0.114 * arr[..., 2])
+        out = np.repeat(g[..., None], self.num_output_channels, axis=-1)
+        return out.astype(_hwc(img).dtype)
+
+
+def _blend(a, b, ratio):
+    out = ratio * a.astype(np.float32) + (1.0 - ratio) * b
+    if np.issubdtype(np.asarray(a).dtype, np.integer):
+        return np.clip(out, 0, 255).astype(np.asarray(a).dtype)
+    # float images: the value scale (0-1 vs 0-255) is not knowable from
+    # the data, so clip only the lower bound (matches reference behavior
+    # for float inputs)
+    return np.clip(out, 0.0, None)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if not self.value:
+            return _hwc(img)
+        f = np.random.uniform(max(0.0, 1.0 - self.value), 1.0 + self.value)
+        return _blend(_hwc(img), np.zeros_like(_hwc(img), np.float32), f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if not self.value:
+            return _hwc(img)
+        arr = _hwc(img)
+        f = np.random.uniform(max(0.0, 1.0 - self.value), 1.0 + self.value)
+        # reference (F.adjust_contrast): blend toward the mean of the
+        # LUMINANCE-weighted grayscale, not the raw channel mean
+        mean = Grayscale(1)(arr).astype(np.float32).mean()
+        return _blend(arr, np.full_like(arr, mean, dtype=np.float32), f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if not self.value:
+            return _hwc(img)
+        arr = _hwc(img)
+        f = np.random.uniform(max(0.0, 1.0 - self.value), 1.0 + self.value)
+        gray = Grayscale(3)(arr).astype(np.float32)
+        return _blend(arr, gray, f)
+
+
+class HueTransform(BaseTransform):
+    """Hue rotation via the RGB-space linear approximation (YIQ rotation),
+    matching the reference's behavior for small factors."""
+
+    def __init__(self, value):
+        self.value = value  # in [0, 0.5]
+
+    def __call__(self, img):
+        if not self.value:
+            return _hwc(img)
+        arr = _hwc(img)
+        if arr.ndim != 3 or arr.shape[-1] != 3:
+            return arr  # hue rotation is undefined off 3-channel RGB
+        theta = np.random.uniform(-self.value, self.value) * 2.0 * np.pi
+        c, s = np.cos(theta), np.sin(theta)
+        m = (np.array([[0.299, 0.587, 0.114]] * 3, np.float32)
+             + c * np.array([[0.701, -0.587, -0.114],
+                             [-0.299, 0.413, -0.114],
+                             [-0.299, -0.587, 0.886]], np.float32)
+             + s * np.array([[0.168, 0.330, -0.497],
+                             [-0.328, 0.035, 0.292],
+                             [1.25, -1.05, -0.203]], np.float32))
+        out = _hwc(arr).astype(np.float32) @ m.T
+        if np.issubdtype(arr.dtype, np.integer):
+            return np.clip(out, 0, 255).astype(arr.dtype)
+        return np.clip(out, 0.0, None)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0,
+                 hue=0.0):
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def __call__(self, img):
+        arr = _hwc(img)
+        for t in np.random.permutation(self.transforms):
+            arr = t(arr)
+        return arr
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0):
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self.expand = expand
+        self.fill = fill
+        self.order = {"nearest": 0, "bilinear": 1}.get(interpolation, 0)
+        if center is not None:
+            raise NotImplementedError(
+                "RandomRotation(center=...) is not supported; rotation is "
+                "about the image center")
+
+    def __call__(self, img):
+        from scipy import ndimage
+        arr = _hwc(img)
+        angle = np.random.uniform(*self.degrees)
+        axes = (1, 0)
+        return ndimage.rotate(arr, angle, axes=axes, reshape=self.expand,
+                              order=self.order, mode="constant",
+                              cval=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """reference: paddle.vision.transforms.RandomErasing over CHW
+    tensors/arrays."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def __call__(self, img):
+        is_tensor = isinstance(img, Tensor)
+        arr = img.numpy().copy() if is_tensor else np.array(_hwc(img))
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        if np.random.rand() < self.prob:
+            for _ in range(10):
+                area = h * w * np.random.uniform(*self.scale)
+                ratio = np.random.uniform(*self.ratio)
+                eh = int(round(np.sqrt(area * ratio)))
+                ew = int(round(np.sqrt(area / ratio)))
+                if eh < h and ew < w:
+                    i = np.random.randint(0, h - eh + 1)
+                    j = np.random.randint(0, w - ew + 1)
+                    if chw:
+                        arr[:, i:i + eh, j:j + ew] = self.value
+                    else:
+                        arr[i:i + eh, j:j + ew] = self.value
+                    break
+        return Tensor(arr) if is_tensor else arr
+
+
+class _FusedToTensorNormalize(BaseTransform):
+    """Compose-internal fusion of ToTensor(CHW) + Normalize(CHW); see
+    Compose._fuse.  Numerically identical to running the pair."""
+
+    def __init__(self, to_tensor, normalize):
+        self.to_tensor = to_tensor
+        self.normalize = normalize
+
+    def __call__(self, img):
+        from ..io.native import imgproc
+        arr = np.asarray(img)
+        if (imgproc.available() and arr.dtype == np.uint8
+                and arr.ndim == 3):
+            # mirror ToTensor's conditional /255 (it only rescales when
+            # values exceed 1.5 — e.g. a {0,1} uint8 mask is NOT scaled)
+            out = imgproc.to_chw_f32(arr, mean=self.normalize.mean,
+                                     std=self.normalize.std,
+                                     unit_scale=bool(arr.max() > 1.5))
+            return Tensor(out)
+        return self.normalize(self.to_tensor(img))

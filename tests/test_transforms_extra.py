@@ -1,0 +1,94 @@
+"""Round-2 vision transforms additions (reference:
+python/paddle/vision/transforms/transforms.py)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.vision import transforms as T
+
+
+def _img(h=8, w=8, c=3, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 256, (h, w, c)).astype(np.uint8)
+
+
+def test_pad_modes():
+    img = _img()
+    out = T.Pad(2)(img)
+    assert out.shape == (12, 12, 3)
+    assert (out[:2] == 0).all()
+    out2 = T.Pad((1, 2), padding_mode="edge")(img)
+    assert out2.shape == (12, 10, 3)
+
+
+def test_grayscale():
+    img = _img()
+    g1 = T.Grayscale()(img)
+    assert g1.shape == (8, 8, 1)
+    g3 = T.Grayscale(3)(img)
+    assert g3.shape == (8, 8, 3)
+    np.testing.assert_array_equal(g3[..., 0], g3[..., 1])
+
+
+def test_color_jitter_family():
+    np.random.seed(0)
+    img = _img()
+    for t in (T.BrightnessTransform(0.4), T.ContrastTransform(0.4),
+              T.SaturationTransform(0.4), T.HueTransform(0.2),
+              T.ColorJitter(0.2, 0.2, 0.2, 0.1)):
+        out = t(img)
+        assert out.shape == img.shape and out.dtype == img.dtype
+    # zero-strength: identity
+    np.testing.assert_array_equal(T.BrightnessTransform(0.0)(img), img)
+
+
+def test_random_rotation():
+    np.random.seed(1)
+    img = _img(16, 16)
+    out = T.RandomRotation(30)(img)
+    assert out.shape == (16, 16, 3)
+    out2 = T.RandomRotation(90, expand=True)(img)
+    assert out2.shape[2] == 3
+
+
+def test_random_erasing():
+    np.random.seed(2)
+    img = np.ones((3, 16, 16), np.float32)
+    out = T.RandomErasing(prob=1.0, value=0.0)(pt.to_tensor(img))
+    assert float(out.numpy().min()) == 0.0   # some region erased
+    kept = T.RandomErasing(prob=0.0)(img)
+    np.testing.assert_array_equal(kept, img)
+
+
+def test_native_imgproc_parity_and_fusion():
+    """io/native/imgproc.cc fused uint8→normalized-CHW == the numpy
+    ToTensor+Normalize pair; Compose auto-fuses the adjacent pair."""
+    from paddle_tpu.io.native import imgproc
+    mean, std = [0.485, 0.456, 0.406], [0.229, 0.224, 0.225]
+    img = _img()
+    if imgproc.available():
+        got = imgproc.to_chw_f32(img, mean, std)
+        want = (((img.astype(np.float32) / 255.0)
+                 - np.asarray(mean, np.float32))
+                / np.asarray(std, np.float32)).transpose(2, 0, 1)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+        gb = imgproc.to_chw_f32(np.stack([img] * 3), mean, std)
+        np.testing.assert_allclose(gb[1], want, rtol=1e-4, atol=1e-6)
+    pipe = T.Compose([T.ToTensor(), T.Normalize(mean, std)])
+    assert len(pipe.transforms) == 1  # fused
+    fused = pipe(img).numpy()
+    unfused = T.Normalize(mean, std)(T.ToTensor()(img)).numpy()
+    np.testing.assert_allclose(fused, unfused, rtol=1e-4, atol=1e-6)
+    # float input falls back to the numpy pair inside the fused transform
+    fimg = img.astype(np.float32) / 255.0
+    np.testing.assert_allclose(
+        pipe(fimg).numpy(),
+        T.Normalize(mean, std)(T.ToTensor()(fimg)).numpy(),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_compose_pipeline_with_new_transforms():
+    np.random.seed(3)
+    pipe = T.Compose([T.Pad(2), T.RandomRotation(10), T.Grayscale(3),
+                      T.ToTensor()])
+    out = pipe(_img())
+    assert out.shape == [3, 12, 12]
