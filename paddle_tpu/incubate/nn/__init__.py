@@ -1,4 +1,5 @@
-"""paddle.incubate.nn analog: MoE + fused transformer layers."""
+"""paddle.incubate.nn analog: MoE + fused transformer layers + functional."""
+from . import functional  # noqa: F401
 from .moe import MoELayer, moe_ffn, moe_aux_loss  # noqa: F401
 from .fused_transformer import (  # noqa: F401
     FusedMultiHeadAttention, FusedFeedForward,

@@ -1,0 +1,139 @@
+"""paddle.incubate.nn.functional analog — the fused-op surface ported LLM
+code calls (reference: python/paddle/incubate/nn/functional/*: fused CUDA
+kernels).  Here "fused" means one dispatch region XLA fuses on TPU; each op
+is tape-recorded through the engine so it composes with eager autograd.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd import engine
+from ...nn import functional as F
+from ...tensor import Tensor
+
+
+def _t(x):
+    from ...tensor_api import _t as __t
+    return __t(x)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1):
+    """reference: fused_rms_norm — rms normalize + scale (+bias) fused."""
+    out = F.rms_norm(x, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + _t(norm_bias)
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, residual=None):
+    """LayerNorm with optional fused residual add (XLA fuses the chain)."""
+    xt = _t(x)
+    if residual is not None:
+        xt = xt + _t(residual)
+    return F.layer_norm(xt, [xt.shape[-1]], norm_weight, norm_bias, epsilon)
+
+
+def swiglu(x, y=None):
+    """reference: incubate swiglu — silu(x) * y; single-input form splits
+    the last axis in half (the LLaMA MLP fusion)."""
+    xt = _t(x)
+    if y is None:
+        def k(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(a1) * a2
+        return engine.apply("swiglu", k, [xt])
+    return engine.apply(
+        "swiglu", lambda a, b: jax.nn.silu(a) * b, [xt, _t(y)])
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    rotary_emb_base=10000.0):
+    """reference: fused_rotary_position_embedding — RoPE applied to q/k
+    (v passes through) in one region.  Without precomputed sin/cos the
+    angles derive from position_ids (default arange) and rotary_emb_base."""
+    qt = _t(q)
+    b, s, h, d = qt.shape
+
+    def rope_one(x, cos_a, sin_a):
+        if use_neox_rotary_style:
+            x1, x2 = jnp.split(x, 2, axis=-1)
+            rot = jnp.concatenate([-x2, x1], axis=-1)
+            cs = jnp.concatenate([cos_a, cos_a], axis=-1)
+            sn = jnp.concatenate([sin_a, sin_a], axis=-1)
+            return x * cs + rot * sn
+        x1, x2 = x[..., ::2], x[..., 1::2]
+        r1 = x1 * cos_a - x2 * sin_a
+        r2 = x2 * cos_a + x1 * sin_a
+        return jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+
+    def kernel(*arrays):
+        idx = 0
+        qa = arrays[idx]; idx += 1
+        ka = arrays[idx] if k is not None else None
+        idx += 1 if k is not None else 0
+        va = arrays[idx] if v is not None else None
+        idx += 1 if v is not None else 0
+        if sin is not None:
+            sin_a = arrays[idx]; idx += 1
+            cos_a = arrays[idx]; idx += 1
+            sin_a = sin_a.reshape(1, s, 1, -1)
+            cos_a = cos_a.reshape(1, s, 1, -1)
+        else:
+            pos = arrays[idx].astype(jnp.float32) if position_ids is not None \
+                else jnp.arange(s, dtype=jnp.float32)[None, :]
+            inv = 1.0 / (rotary_emb_base
+                         ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+            ang = pos[..., None] * inv                  # [b?, s, d/2]
+            sin_a = jnp.sin(ang)[:, :, None, :]
+            cos_a = jnp.cos(ang)[:, :, None, :]
+        outs = [rope_one(qa, cos_a, sin_a)]
+        if ka is not None:
+            outs.append(rope_one(ka, cos_a, sin_a))
+        if va is not None:
+            outs.append(va)
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    args = [qt]
+    if k is not None:
+        args.append(_t(k))
+    if v is not None:
+        args.append(_t(v))
+    if sin is not None:
+        args += [_t(sin), _t(cos)]
+    elif position_ids is not None:
+        args.append(_t(position_ids))
+    out = engine.apply("fused_rope", kernel, args)
+    if not isinstance(out, tuple):
+        return out, None, None
+    outs = list(out) + [None] * (3 - len(out))
+    if v is None:
+        outs = [outs[0], outs[1] if k is not None else None, None]
+    return tuple(outs[:3])
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    w = _t(weight)
+    if transpose_weight:
+        w = w.transpose([1, 0])
+    return F.linear(_t(x), w, None if bias is None else _t(bias))
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train"):
+    """dropout(x) + y in one region (reference: fused_dropout_add)."""
+    return F.dropout(_t(x), p, training=training, mode=mode) + _t(y)
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, epsilon=1e-5,
+                                           training=True):
+    """reference: fused_bias_dropout_residual_layer_norm."""
+    xt = _t(x)
+    if bias is not None:
+        xt = xt + _t(bias)
+    out = F.dropout(xt, dropout_rate, training=training) + _t(residual)
+    return F.layer_norm(out, [out.shape[-1]], ln_scale, ln_bias, epsilon)

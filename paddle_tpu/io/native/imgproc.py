@@ -58,13 +58,17 @@ def to_chw_f32(img, mean=None, std=None, unit_scale=True):
     optionally normalized.  Caller guarantees availability."""
     img = np.ascontiguousarray(img)
     assert img.dtype == np.uint8 and img.ndim in (3, 4)
+    if (mean is None) != (std is None):
+        raise ValueError("pass both mean and std, or neither")
     m = iv = None
     c = img.shape[-1]
     if mean is not None:
+        # accept scalars, (c,), or pre-shaped (c,1,1) like Normalize does
         m = np.ascontiguousarray(np.broadcast_to(
-            np.asarray(mean, np.float32), (c,)))
+            np.asarray(mean, np.float32).reshape(-1), (c,)))
         iv = np.ascontiguousarray(
-            1.0 / np.broadcast_to(np.asarray(std, np.float32), (c,)))
+            1.0 / np.broadcast_to(
+                np.asarray(std, np.float32).reshape(-1), (c,)))
     if img.ndim == 3:
         h, w, _ = img.shape
         out = np.empty((c, h, w), np.float32)

@@ -239,7 +239,8 @@ class BrightnessTransform(BaseTransform):
         if not self.value:
             return _hwc(img)
         f = np.random.uniform(max(0.0, 1.0 - self.value), 1.0 + self.value)
-        return _blend(_hwc(img), np.zeros_like(_hwc(img), np.float32), f)
+        # scalar second operand: _blend broadcasts, no full-image alloc
+        return _blend(_hwc(img), np.float32(0.0), f)
 
 
 class ContrastTransform(BaseTransform):
@@ -254,7 +255,7 @@ class ContrastTransform(BaseTransform):
         # reference (F.adjust_contrast): blend toward the mean of the
         # LUMINANCE-weighted grayscale, not the raw channel mean
         mean = Grayscale(1)(arr).astype(np.float32).mean()
-        return _blend(arr, np.full_like(arr, mean, dtype=np.float32), f)
+        return _blend(arr, np.float32(mean), f)
 
 
 class SaturationTransform(BaseTransform):
@@ -346,12 +347,20 @@ class RandomErasing(BaseTransform):
         self.scale = scale
         self.ratio = ratio
         self.value = value
+        self.inplace = inplace
 
     def __call__(self, img):
         is_tensor = isinstance(img, Tensor)
-        arr = img.numpy().copy() if is_tensor else np.array(_hwc(img))
+        if is_tensor:
+            arr = img.numpy().copy()   # jax arrays are immutable
+        else:
+            arr = _hwc(img) if self.inplace else np.array(_hwc(img))
         chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
         h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        value = np.asarray(self.value, arr.dtype)
+        if value.ndim == 1:
+            # per-channel fill broadcasts along the channel axis
+            value = value.reshape(-1, 1, 1) if chw else value.reshape(1, 1, -1)
         if np.random.rand() < self.prob:
             for _ in range(10):
                 area = h * w * np.random.uniform(*self.scale)
@@ -362,9 +371,9 @@ class RandomErasing(BaseTransform):
                     i = np.random.randint(0, h - eh + 1)
                     j = np.random.randint(0, w - ew + 1)
                     if chw:
-                        arr[:, i:i + eh, j:j + ew] = self.value
+                        arr[:, i:i + eh, j:j + ew] = value
                     else:
-                        arr[i:i + eh, j:j + ew] = self.value
+                        arr[i:i + eh, j:j + ew] = value
                     break
         return Tensor(arr) if is_tensor else arr
 

@@ -1,0 +1,111 @@
+"""incubate.nn.functional fused ops (reference:
+python/paddle/incubate/nn/functional)."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+from paddle_tpu.incubate.nn import functional as IF
+
+
+def test_fused_rms_norm_matches_plain():
+    pt.seed(0)
+    x = pt.randn([2, 5, 8])
+    w = pt.ones([8])
+    np.testing.assert_allclose(IF.fused_rms_norm(x, w).numpy(),
+                               F.rms_norm(x, w).numpy(), rtol=1e-6)
+
+
+def test_fused_layer_norm_with_residual():
+    pt.seed(1)
+    x, r = pt.randn([2, 8]), pt.randn([2, 8])
+    w, b = pt.ones([8]), pt.zeros([8])
+    got = IF.fused_layer_norm(x, w, b, residual=r).numpy()
+    want = F.layer_norm(x + r, [8], w, b).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_swiglu_single_and_two_input():
+    pt.seed(2)
+    x = pt.randn([4, 16])
+    one = IF.swiglu(x).numpy()
+    a, b = x.numpy()[:, :8], x.numpy()[:, 8:]
+    want = np.asarray(jnp.asarray(a) * jnp.asarray(
+        1.0 / (1.0 + np.exp(-a)))) * b
+    np.testing.assert_allclose(one, want, rtol=1e-4, atol=1e-5)
+    two = IF.swiglu(pt.to_tensor(a), pt.to_tensor(b)).numpy()
+    np.testing.assert_allclose(two, want, rtol=1e-4, atol=1e-5)
+    # differentiable
+    xx = pt.randn([4, 16]); xx.stop_gradient = False
+    IF.swiglu(xx).mean().backward()
+    assert xx.grad is not None
+
+
+def test_fused_rope_matches_llama_rope():
+    """interleaved style (use_neox_rotary_style=False) must equal the
+    LLaMA model's own _rope."""
+    from paddle_tpu.text.llama import _rope
+    pt.seed(3)
+    b, s, h, d = 2, 6, 4, 8
+    q, k = pt.randn([b, s, h, d]), pt.randn([b, s, h, d])
+    qo, ko, vo = IF.fused_rotary_position_embedding(
+        q, k, use_neox_rotary_style=False)
+    pos = np.arange(s)[None, :]
+    wq, wk = _rope(jnp.asarray(q.numpy()), jnp.asarray(k.numpy()),
+                   jnp.asarray(pos), 10000.0)
+    np.testing.assert_allclose(qo.numpy(), np.asarray(wq), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(ko.numpy(), np.asarray(wk), rtol=1e-4,
+                               atol=1e-5)
+    assert vo is None
+
+
+def test_fused_rope_neox_rotation_norm_preserving():
+    pt.seed(4)
+    q = pt.randn([1, 5, 2, 8])
+    qo, _, _ = IF.fused_rotary_position_embedding(q)
+    # rotations preserve the per-pair norm => overall vector norm
+    np.testing.assert_allclose(
+        np.linalg.norm(qo.numpy(), axis=-1),
+        np.linalg.norm(q.numpy(), axis=-1), rtol=1e-4)
+
+
+def test_fused_rope_position_ids():
+    pt.seed(5)
+    q = pt.randn([1, 4, 2, 8])
+    ids = pt.to_tensor(np.array([[3, 4, 5, 6]], np.int32))
+    qo, _, _ = IF.fused_rotary_position_embedding(
+        q, position_ids=ids, use_neox_rotary_style=False)
+    # matches shifting via default positions on a longer sequence
+    q8_np = np.zeros((1, 8, 2, 8), np.float32)
+    q8_np[:, 3:7] = q.numpy()
+    qo8, _, _ = IF.fused_rotary_position_embedding(
+        pt.to_tensor(q8_np), use_neox_rotary_style=False)
+    np.testing.assert_allclose(qo.numpy(), qo8.numpy()[:, 3:7], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_fused_dropout_add_and_bias_ln():
+    pt.seed(6)
+    x, y = pt.randn([3, 8]), pt.randn([3, 8])
+    out = IF.fused_dropout_add(x, y, p=0.0)
+    np.testing.assert_allclose(out.numpy(), (x + y).numpy(), rtol=1e-6)
+    w, b = pt.ones([8]), pt.zeros([8])
+    got = IF.fused_bias_dropout_residual_layer_norm(
+        x, y, ln_scale=w, ln_bias=b, dropout_rate=0.0).numpy()
+    want = F.layer_norm(x + y, [8], w, b).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_fused_linear():
+    pt.seed(7)
+    x = pt.randn([3, 4])
+    w = pt.randn([4, 5])
+    np.testing.assert_allclose(IF.fused_linear(x, w).numpy(),
+                               x.numpy() @ w.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    wt = pt.to_tensor(w.numpy().T.copy())
+    np.testing.assert_allclose(
+        IF.fused_linear(x, wt, transpose_weight=True).numpy(),
+        x.numpy() @ w.numpy(), rtol=1e-4, atol=1e-5)
