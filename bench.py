@@ -99,14 +99,15 @@ def probe_backend():
 
 
 # ============================================================ child: benches
-def run_gpt(preset, seq_len, batch, steps=20, warmup=3):
+def run_gpt(preset, seq_len, batch, steps=20, warmup=3, **cfg_kw):
     import paddle_tpu as pt
     from paddle_tpu.text import GPTConfig, GPTForCausalLM, gpt_loss_fn
 
     pt.seed(0)
     cfg = GPTConfig.from_preset(
         preset, vocab_size=50304, max_position_embeddings=seq_len,
-        hidden_dropout=0.0, attention_dropout=0.0, tensor_parallel=False)
+        hidden_dropout=0.0, attention_dropout=0.0, tensor_parallel=False,
+        **cfg_kw)
     model = GPTForCausalLM(cfg)
     # pure bf16 (AMP O2, no fp32 master): Adafactor's factored state keeps
     # optimizer memory negligible so the 1.3B preset fits one chip's HBM
@@ -131,7 +132,18 @@ def run_gpt(preset, seq_len, batch, steps=20, warmup=3):
 
     tokens = batch * seq_len * steps
     n_params = sum(p.size for p in model.parameters())
-    return {"tps": tokens / dt, "n_params": int(n_params), "loss": final}
+    # MoE: per-token ACTIVE params (dense share + top_k/E of the experts)
+    # — the honest basis for a dense-baseline comparison
+    active = n_params
+    if cfg.num_experts:
+        from paddle_tpu.incubate.nn import MoELayer
+        for layer in model.sublayers():
+            if isinstance(layer, MoELayer):
+                ep = (layer.w1.size + layer.b1.size + layer.w2.size
+                      + layer.b2.size)
+                active -= int(ep * (1.0 - layer.top_k / layer.num_experts))
+    return {"tps": tokens / dt, "n_params": int(n_params),
+            "active_params": int(active), "loss": final}
 
 
 def run_resnet(batch=256, steps=20, warmup=3, s2d_stem=True):
@@ -215,7 +227,18 @@ def run_llama(steps=10, warmup=2, hidden=2048, layers=16, heads=16,
             "loss": final}
 
 
-CHILD_FNS = {"gpt": run_gpt, "resnet": run_resnet, "llama": run_llama}
+def run_moe(steps=10, warmup=2, preset="gpt3-350M", experts=8, top_k=2,
+            batch=8, seq=1024):
+    """GPT-MoE leg = run_gpt with a routed-FFN config (GShard dispatch
+    einsums through the same fused step).  On one chip ep=1 (experts
+    replicated) so this measures the routed compute; multi-chip runs
+    shard experts over 'ep'."""
+    return run_gpt(preset, seq, batch, steps=steps, warmup=warmup,
+                   num_experts=experts, moe_top_k=top_k)
+
+
+CHILD_FNS = {"gpt": run_gpt, "resnet": run_resnet, "llama": run_llama,
+             "moe": run_moe}
 
 
 def _child_main(spec):
@@ -331,6 +354,19 @@ def main():
                           "tokens/sec/chip",
                 "value": round(res["tps"], 1), "unit": "tokens/s/chip",
                 "vs_baseline": round(res["tps"] / base, 3)}))
+    if _left() > 400:
+        res = _spawn({"kind": "moe"}, min(PRESET_TIMEOUT, _left()))
+        if res:
+            # baseline scaled by ACTIVE (per-token) params, matching the
+            # dense legs' compute-for-compute methodology
+            act = res.get("active_params") or res["n_params"]
+            base = A100_GPT13_TOKENS_PER_SEC * (1.3e9 / max(act, 1))
+            _log(json.dumps({
+                "metric": "GPT-MoE 8-expert top-2 train tokens/sec/chip",
+                "value": round(res["tps"], 1), "unit": "tokens/s/chip",
+                "vs_baseline": round(res["tps"] / base, 3),
+                "total_params": res["n_params"],
+                "active_params": act}))
 
 
 if __name__ == "__main__":

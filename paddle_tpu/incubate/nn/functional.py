@@ -21,7 +21,11 @@ def _t(x):
 def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
                    begin_norm_axis=-1):
     """reference: fused_rms_norm — rms normalize + scale (+bias) fused."""
-    out = F.rms_norm(x, norm_weight, epsilon)
+    xt = _t(x)
+    if begin_norm_axis not in (-1, xt.ndim - 1):
+        raise NotImplementedError(
+            "fused_rms_norm normalizes the last axis only")
+    out = F.rms_norm(xt, norm_weight, epsilon)
     if norm_bias is not None:
         out = out + _t(norm_bias)
     return out
@@ -29,11 +33,15 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
 
 def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
                      begin_norm_axis=-1, residual=None):
-    """LayerNorm with optional fused residual add (XLA fuses the chain)."""
+    """LayerNorm with optional fused residual add (XLA fuses the chain);
+    begin_norm_axis selects the normalized trailing axes like the
+    reference."""
     xt = _t(x)
     if residual is not None:
         xt = xt + _t(residual)
-    return F.layer_norm(xt, [xt.shape[-1]], norm_weight, norm_bias, epsilon)
+    axis = begin_norm_axis if begin_norm_axis >= 0 else xt.ndim - 1
+    return F.layer_norm(xt, list(xt.shape[axis:]), norm_weight, norm_bias,
+                        epsilon)
 
 
 def swiglu(x, y=None):
@@ -107,12 +115,12 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     elif position_ids is not None:
         args.append(_t(position_ids))
     out = engine.apply("fused_rope", kernel, args)
-    if not isinstance(out, tuple):
-        return out, None, None
-    outs = list(out) + [None] * (3 - len(out))
-    if v is None:
-        outs = [outs[0], outs[1] if k is not None else None, None]
-    return tuple(outs[:3])
+    outs = list(out) if isinstance(out, tuple) else [out]
+    # kernel emits [q, k?, v?] in order — map back to fixed (q, k, v) slots
+    q_out = outs.pop(0)
+    k_out = outs.pop(0) if k is not None else None
+    v_out = outs.pop(0) if v is not None else None
+    return q_out, k_out, v_out
 
 
 def fused_linear(x, weight, bias=None, transpose_weight=False):

@@ -2,5 +2,5 @@ from . import lr  # noqa: F401
 from .optimizer import Optimizer, L2Decay, L1Decay  # noqa: F401
 from .optimizers import (  # noqa: F401
     SGD, Momentum, Adagrad, RMSProp, Adadelta, Adam, AdamW, Lamb, Adamax,
-    Adafactor, NAdam, RAdam, ASGD, Rprop,
+    Adafactor, NAdam, RAdam, ASGD, Rprop, LBFGS,
 )

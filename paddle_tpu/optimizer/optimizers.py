@@ -4,6 +4,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..tensor import Tensor
 from .optimizer import Optimizer
 
 
@@ -378,3 +379,231 @@ class Rprop(Optimizer):
         slots["prev_grad"] = g_eff
         slots["learning_rate"] = step_size
         return p - (step_size * jnp.sign(g_eff)).astype(p.dtype), slots
+
+
+class LBFGS(Optimizer):
+    """reference: python/paddle/optimizer/lbfgs.py — limited-memory BFGS
+    with optional strong-Wolfe line search, closure-driven:
+
+        def closure():
+            opt.clear_grad()
+            loss = loss_fn(model(x), y)
+            loss.backward()
+            return loss
+        opt.step(closure)
+
+    Eager-only by design (the line search re-evaluates the closure a
+    data-dependent number of times — the reference's is CPU-driven too);
+    the per-iteration math runs on device through the tape.
+    """
+
+    SLOTS = ()
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         **kw)
+        self._max_iter = max_iter
+        self._max_eval = max_eval if max_eval is not None \
+            else max_iter * 5 // 4
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._hist = history_size
+        self._line_search = line_search_fn
+        self._state_lb = {"s": [], "y": [], "rho": [], "prev_flat_grad": None,
+                          "prev_loss": None}
+
+    # ---- checkpointing: the curvature history IS the optimizer state ---
+    def state_dict(self):
+        out = super().state_dict()
+        lb = self._state_lb
+        for i, (s, y, rho) in enumerate(zip(lb["s"], lb["y"], lb["rho"])):
+            out[f"__lbfgs__/s{i}"] = Tensor._from_array(s)
+            out[f"__lbfgs__/y{i}"] = Tensor._from_array(y)
+            out[f"__lbfgs__/rho{i}"] = Tensor._from_array(
+                jnp.asarray(rho, jnp.float32))
+        if lb["prev_loss"] is not None:
+            out["__lbfgs__/prev_loss"] = Tensor._from_array(
+                jnp.asarray(lb["prev_loss"], jnp.float32))
+        return out
+
+    def set_state_dict(self, state):
+        import numpy as _np
+        lb = {"s": [], "y": [], "rho": [], "prev_flat_grad": None,
+              "prev_loss": None}
+        i = 0
+        while f"__lbfgs__/s{i}" in state:
+            def arr(k):
+                v = state[k]
+                return v._array if isinstance(v, Tensor) else jnp.asarray(v)
+            lb["s"].append(arr(f"__lbfgs__/s{i}"))
+            lb["y"].append(arr(f"__lbfgs__/y{i}"))
+            lb["rho"].append(float(_np.asarray(state[f"__lbfgs__/rho{i}"])))
+            i += 1
+        if "__lbfgs__/prev_loss" in state:
+            lb["prev_loss"] = float(_np.asarray(
+                state["__lbfgs__/prev_loss"]))
+        self._state_lb = lb
+        super().set_state_dict(
+            {k: v for k, v in state.items() if "__lbfgs__/" not in k})
+
+    # ---- flat helpers (host orchestration; math stays in jnp) ----------
+    def _gather_flat_grad(self):
+        grads = [(p.grad._array if p.grad is not None
+                  else jnp.zeros_like(p._array)) for p in self._parameters]
+        if self._grad_clip is not None:
+            grads = self._clip_grad_arrays(grads)
+        flat = jnp.concatenate([g.reshape(-1).astype(jnp.float32)
+                                for g in grads])
+        wd = self._weight_decay
+        if wd:  # coupled L2 on the flattened params
+            flat = flat + float(wd) * self._flat_params()
+        return flat
+
+    def _flat_params(self):
+        return jnp.concatenate([
+            p._array.reshape(-1).astype(jnp.float32)
+            for p in self._parameters])
+
+    def _set_flat_params(self, flat):
+        off = 0
+        for p in self._parameters:
+            n = p._array.size
+            p._inplace_assign(
+                flat[off:off + n].reshape(p._array.shape)
+                .astype(p._array.dtype))
+            off += n
+
+    def _directional(self, closure, x0, d, t):
+        self._set_flat_params(x0 + t * d)
+        loss = float(closure())
+        g = self._gather_flat_grad()
+        return loss, float(jnp.vdot(g, d)), g
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure that "
+                             "recomputes loss and gradients")
+        lb = self._state_lb
+        lr = self.get_lr()
+        loss = float(closure())
+        flat_grad = self._gather_flat_grad()
+        if float(jnp.abs(flat_grad).max()) <= self._tol_grad:
+            return loss
+        n_eval = 1
+        for _ in range(self._max_iter):
+            # two-loop recursion
+            q = flat_grad
+            alphas = []
+            for s, y, rho in zip(reversed(lb["s"]), reversed(lb["y"]),
+                                 reversed(lb["rho"])):
+                a = rho * float(jnp.vdot(s, q))
+                alphas.append(a)
+                q = q - a * y
+            if lb["y"]:
+                y_last, s_last = lb["y"][-1], lb["s"][-1]
+                gamma = float(jnp.vdot(s_last, y_last)
+                              / jnp.maximum(jnp.vdot(y_last, y_last), 1e-10))
+                r = gamma * q
+            else:
+                r = q
+            for (s, y, rho), a in zip(zip(lb["s"], lb["y"], lb["rho"]),
+                                      reversed(alphas)):
+                b = rho * float(jnp.vdot(y, r))
+                r = r + (a - b) * s
+            d = -r
+            gtd = float(jnp.vdot(flat_grad, d))
+            if gtd > -self._tol_change:
+                break
+            x0 = self._flat_params()
+            t = lr if lb["prev_loss"] is not None else \
+                min(1.0, 1.0 / float(jnp.abs(flat_grad).sum())) * lr
+            if self._line_search == "strong_wolfe":
+                t, loss_new, g_new, evals = _strong_wolfe(
+                    lambda tt: self._directional(closure, x0, d, tt),
+                    t, loss, gtd)
+                n_eval += evals
+                self._set_flat_params(x0 + t * d)
+            else:
+                self._set_flat_params(x0 + t * d)
+                loss_new = float(closure())
+                g_new = self._gather_flat_grad()
+                n_eval += 1
+            s_vec = t * d
+            y_vec = g_new - flat_grad
+            sy = float(jnp.vdot(s_vec, y_vec))
+            if sy > 1e-10:
+                if len(lb["s"]) >= self._hist:
+                    lb["s"].pop(0); lb["y"].pop(0); lb["rho"].pop(0)
+                lb["s"].append(s_vec)
+                lb["y"].append(y_vec)
+                lb["rho"].append(1.0 / sy)
+            delta = abs(loss_new - loss)
+            loss, flat_grad = loss_new, g_new
+            lb["prev_loss"] = loss
+            if (float(jnp.abs(flat_grad).max()) <= self._tol_grad
+                    or delta < self._tol_change
+                    or n_eval >= self._max_eval):
+                break
+        self._step_count += 1
+        return loss
+
+
+def _strong_wolfe(phi, t, f0, gtd0, c1=1e-4, c2=0.9, max_ls=25):
+    """Strong-Wolfe line search on phi(t) -> (loss, dir-deriv, grad).
+
+    INVARIANT: the returned (t, f, g) always come from the SAME phi(t)
+    evaluation — LBFGS pairs the gradient with x0 + t*d, so a mismatched
+    triple would corrupt the curvature history.
+    """
+    t_prev, f_prev = 0.0, f0
+    evals = 0
+    f_new, gtd_new, g_new = phi(t)
+    evals += 1
+    for _ in range(max_ls):
+        if f_new > f0 + c1 * t * gtd0 or (evals > 1 and f_new >= f_prev):
+            # zoom between t_prev and t; (t_best, ...) tracks the lowest
+            # Armijo-acceptable evaluated point as a consistent fallback
+            lo, hi = t_prev, t
+            f_lo = f_prev
+            best = (t, f_new, g_new)
+            for _ in range(max_ls):
+                tm = 0.5 * (lo + hi)
+                f_m, gtd_m, g_m = phi(tm)
+                evals += 1
+                if f_m <= f0 + c1 * tm * gtd0 and f_m < best[1]:
+                    best = (tm, f_m, g_m)
+                if f_m > f0 + c1 * tm * gtd0 or f_m >= f_lo:
+                    hi = tm
+                else:
+                    if abs(gtd_m) <= -c2 * gtd0:
+                        return tm, f_m, g_m, evals
+                    if gtd_m * (hi - lo) >= 0:
+                        hi = lo
+                    lo, f_lo = tm, f_m
+            return best + (evals,)
+        if abs(gtd_new) <= -c2 * gtd0:
+            return t, f_new, g_new, evals
+        if gtd_new >= 0:
+            lo, hi = t, t_prev
+            best = (t, f_new, g_new)
+            for _ in range(max_ls):
+                tm = 0.5 * (lo + hi)
+                f_m, gtd_m, g_m = phi(tm)
+                evals += 1
+                if f_m <= f0 + c1 * tm * gtd0 and f_m < best[1]:
+                    best = (tm, f_m, g_m)
+                if f_m > f0 + c1 * tm * gtd0:
+                    hi = tm
+                elif abs(gtd_m) <= -c2 * gtd0:
+                    return tm, f_m, g_m, evals
+                else:
+                    lo = tm
+            return best + (evals,)
+        t_prev, f_prev = t, f_new
+        t = 2.0 * t
+        f_new, gtd_new, g_new = phi(t)
+        evals += 1
+    return t, f_new, g_new, evals

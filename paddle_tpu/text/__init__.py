@@ -12,5 +12,8 @@ from .llama import (  # noqa: F401
 )
 from .ernie import (  # noqa: F401
     ErnieConfig, ErnieModel, ErnieForSequenceClassification,
+    ErnieForTokenClassification, ErnieForQuestionAnswering,
+    ErnieForMaskedLM, ErnieForPretraining, ernie_config_from_preset,
+    ERNIE3_PRESETS,
 )
 from .generation import generate, beam_search  # noqa: F401

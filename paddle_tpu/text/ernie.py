@@ -66,3 +66,107 @@ class ErnieForSequenceClassification(nn.Layer):
         _, pooled = self.ernie(input_ids, token_type_ids, position_ids,
                                attention_mask)
         return self.classifier(self.dropout(pooled))
+
+
+# ERNIE-3.0 released sizes (reference: PaddleNLP ernie-3.0-{nano..base})
+ERNIE3_PRESETS = {
+    "ernie-3.0-nano-zh": dict(hidden_size=312, num_hidden_layers=4,
+                              num_attention_heads=12,
+                              intermediate_size=1248),
+    "ernie-3.0-micro-zh": dict(hidden_size=384, num_hidden_layers=4,
+                               num_attention_heads=12,
+                               intermediate_size=1536),
+    "ernie-3.0-mini-zh": dict(hidden_size=384, num_hidden_layers=6,
+                              num_attention_heads=12,
+                              intermediate_size=1536),
+    "ernie-3.0-medium-zh": dict(hidden_size=768, num_hidden_layers=6,
+                                num_attention_heads=12,
+                                intermediate_size=3072),
+    "ernie-3.0-base-zh": dict(hidden_size=768, num_hidden_layers=12,
+                              num_attention_heads=12,
+                              intermediate_size=3072),
+}
+
+
+def ernie_config_from_preset(name, **kw):
+    return ErnieConfig(**{**ERNIE3_PRESETS[name], **kw})
+
+
+class ErnieForTokenClassification(nn.Layer):
+    def __init__(self, cfg: ErnieConfig = None, num_classes=2, **kw):
+        super().__init__()
+        self.ernie = ErnieModel(cfg, **kw)
+        c = self.ernie.cfg
+        self.dropout = nn.Dropout(c.hidden_dropout_prob)
+        self.classifier = nn.Linear(c.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq, _ = self.ernie(input_ids, token_type_ids, position_ids,
+                            attention_mask)
+        return self.classifier(self.dropout(seq))
+
+
+class ErnieForQuestionAnswering(nn.Layer):
+    """Start/end span logits (reference: ErnieForQuestionAnswering)."""
+
+    def __init__(self, cfg: ErnieConfig = None, **kw):
+        super().__init__()
+        self.ernie = ErnieModel(cfg, **kw)
+        self.classifier = nn.Linear(self.ernie.cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq, _ = self.ernie(input_ids, token_type_ids, position_ids,
+                            attention_mask)
+        logits = self.classifier(seq)            # [b, s, 2]
+        start, end = logits[:, :, 0], logits[:, :, 1]
+        return start, end
+
+
+class ErnieLMHead(nn.Layer):
+    """Transform + tied-embedding decoder for MLM."""
+
+    def __init__(self, ernie: "ErnieModel"):
+        super().__init__()
+        c = ernie.cfg
+        self.transform = nn.Linear(c.hidden_size, c.hidden_size)
+        self.layer_norm = nn.LayerNorm(c.hidden_size)
+        self.decoder_bias = self.create_parameter(
+            [c.vocab_size], is_bias=True,
+            default_initializer=nn.initializer.Constant(0.0))
+        self._word_emb = [ernie.bert.embeddings.word_embeddings]
+
+    def forward(self, seq):
+        h = self.layer_norm(F.gelu(self.transform(seq)))
+        w = self._word_emb[0].weight                  # tied [V, H]
+        return h.matmul(w, transpose_y=True) + self.decoder_bias
+
+
+class ErnieForMaskedLM(nn.Layer):
+    def __init__(self, cfg: ErnieConfig = None, **kw):
+        super().__init__()
+        self.ernie = ErnieModel(cfg, **kw)
+        self.lm_head = ErnieLMHead(self.ernie)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq, _ = self.ernie(input_ids, token_type_ids, position_ids,
+                            attention_mask)
+        return self.lm_head(seq)
+
+
+class ErnieForPretraining(nn.Layer):
+    """MLM + sentence-order (NSP-style) heads."""
+
+    def __init__(self, cfg: ErnieConfig = None, **kw):
+        super().__init__()
+        self.ernie = ErnieModel(cfg, **kw)
+        self.lm_head = ErnieLMHead(self.ernie)
+        self.sop_head = nn.Linear(self.ernie.cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                                 attention_mask)
+        return self.lm_head(seq), self.sop_head(pooled)

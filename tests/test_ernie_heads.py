@@ -1,0 +1,48 @@
+"""ERNIE-3.0 task heads + presets (reference: PaddleNLP ernie)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.text import (
+    ErnieForMaskedLM, ErnieForPretraining, ErnieForQuestionAnswering,
+    ErnieForTokenClassification, ernie_config_from_preset,
+)
+
+
+def _cfg():
+    return ernie_config_from_preset(
+        "ernie-3.0-nano-zh", vocab_size=128, max_position_embeddings=64)
+
+
+def test_token_classification_and_qa():
+    pt.seed(0)
+    ids = pt.randint(0, 128, [2, 10])
+    tok = ErnieForTokenClassification(_cfg(), num_classes=7)
+    assert tok(ids).shape == [2, 10, 7]
+    qa = ErnieForQuestionAnswering(_cfg())
+    start, end = qa(ids)
+    assert start.shape == [2, 10] and end.shape == [2, 10]
+
+
+def test_mlm_tied_embeddings_and_pretraining():
+    pt.seed(1)
+    ids = pt.randint(0, 128, [2, 8])
+    mlm = ErnieForMaskedLM(_cfg())
+    logits = mlm(ids)
+    assert logits.shape == [2, 8, 128]
+    # the decoder must be TIED to the word embedding (no duplicate weight)
+    emb_id = id(mlm.ernie.bert.embeddings.word_embeddings.weight)
+    assert not any(
+        id(p) != emb_id and p.shape == [128, 312]
+        for _, p in mlm.lm_head.named_parameters())
+    loss = pt.nn.functional.cross_entropy(logits, ids)
+    loss.backward()
+    assert mlm.ernie.bert.embeddings.word_embeddings.weight.grad is not None
+
+    pre = ErnieForPretraining(_cfg())
+    ml, sop = pre(ids)
+    assert ml.shape == [2, 8, 128] and sop.shape == [2, 2]
+
+
+def test_preset_table_shapes():
+    cfg = ernie_config_from_preset("ernie-3.0-base-zh")
+    assert cfg.hidden_size == 768 and cfg.num_hidden_layers == 12
