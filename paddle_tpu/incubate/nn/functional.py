@@ -39,7 +39,7 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
     xt = _t(x)
     if residual is not None:
         xt = xt + _t(residual)
-    axis = begin_norm_axis if begin_norm_axis >= 0 else xt.ndim - 1
+    axis = begin_norm_axis % xt.ndim
     return F.layer_norm(xt, list(xt.shape[axis:]), norm_weight, norm_bias,
                         epsilon)
 

@@ -412,8 +412,14 @@ class LBFGS(Optimizer):
         self._tol_change = tolerance_change
         self._hist = history_size
         self._line_search = line_search_fn
-        self._state_lb = {"s": [], "y": [], "rho": [], "prev_flat_grad": None,
-                          "prev_loss": None}
+        # the flat-vector math cannot honor per-group lr/decay overrides;
+        # reject them up front (torch's LBFGS likewise rejects groups)
+        if any(w is not None for w in self._wd_overrides) or \
+                any(s != 1.0 for s in self._lr_scales):
+            raise ValueError(
+                "LBFGS does not support parameter groups with per-group "
+                "learning_rate/weight_decay (flat-vector optimizer)")
+        self._state_lb = {"s": [], "y": [], "rho": [], "prev_loss": None}
 
     # ---- checkpointing: the curvature history IS the optimizer state ---
     def state_dict(self):
@@ -431,8 +437,7 @@ class LBFGS(Optimizer):
 
     def set_state_dict(self, state):
         import numpy as _np
-        lb = {"s": [], "y": [], "rho": [], "prev_flat_grad": None,
-              "prev_loss": None}
+        lb = {"s": [], "y": [], "rho": [], "prev_loss": None}
         i = 0
         while f"__lbfgs__/s{i}" in state:
             def arr(k):

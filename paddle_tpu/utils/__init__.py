@@ -104,3 +104,5 @@ def run_check():
     print(f"paddle_tpu is installed successfully! "
           f"backend={jax.default_backend()} devices={n}")
     return True
+
+from . import cpp_extension  # noqa: F401,E402

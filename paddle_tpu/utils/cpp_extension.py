@@ -1,0 +1,114 @@
+"""Custom C++ op loading (reference: python/paddle/utils/cpp_extension —
+`load(name, sources)` JIT-compiles user C++ into ops).
+
+TPU-native integration: the user's extern-C kernel is compiled with the
+same g++/ctypes pipeline as the framework's own native pieces
+(io/native/build_so) and registered in the op dispatch table wrapped in
+`jax.pure_callback` — so a host C++ op composes with jit/to_static (XLA
+calls back to the host at that point, like the reference's CPU custom
+ops inside a GPU graph).  Gradients: custom ops are non-differentiable
+unless a `grad_fn` is supplied.
+
+Contract for the C side (float32, the common case):
+
+    extern "C" void my_op(const float* x, float* out, long n);
+
+Python:
+
+    lib = cpp_extension.load(name="square", sources=["square.cc"])
+    square = cpp_extension.register_op(lib, "my_op")   # elementwise
+    y = square(paddle_tensor)          # works eagerly AND under jit
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+
+def load(name, sources, extra_cxx_flags=None, build_directory=None,
+         verbose=False):
+    """Compile `sources` into a shared library and return the ctypes CDLL
+    (reference: cpp_extension.load returning the op module)."""
+    from ..io.native import build_so
+    import subprocess
+    import tempfile
+
+    build_dir = build_directory or tempfile.mkdtemp(prefix=f"pt_ext_{name}_")
+    so_path = os.path.join(build_dir, f"{name}.so")
+    if len(sources) == 1 and not extra_cxx_flags:
+        build_so(os.path.abspath(sources[0]), so_path)
+    else:
+        cmd = (["g++", "-O2", "-shared", "-fPIC"]
+               + list(extra_cxx_flags or [])
+               + ["-o", so_path] + [os.path.abspath(s) for s in sources])
+        subprocess.run(cmd, check=True, capture_output=True)
+    return ctypes.CDLL(so_path)
+
+
+def register_op(lib, fn_name, op_name=None, out_shape_fn=None,
+                grad_fn=None):
+    """Wrap an extern-C elementwise/float32 kernel as a framework op.
+
+    fn(const float* in, float* out, long n) — out_shape_fn(shape)->shape
+    defaults to same-shape.  Returns a python callable over Tensors that
+    records on the tape and lowers through jit via pure_callback."""
+    import jax
+    from ..autograd import engine
+    from ..ops import dispatch
+    from ..tensor import Tensor
+
+    cfn = getattr(lib, fn_name)
+    cfn.argtypes = [ctypes.POINTER(ctypes.c_float),
+                    ctypes.POINTER(ctypes.c_float), ctypes.c_long]
+    name = op_name or f"custom_{fn_name}"
+
+    def host_call(x):
+        x = np.ascontiguousarray(np.asarray(x, np.float32))
+        shape = out_shape_fn(x.shape) if out_shape_fn else x.shape
+        out = np.empty(shape, np.float32)
+        cfn(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_long(x.size))
+        return out
+
+    def kernel(x):
+        shape = out_shape_fn(x.shape) if out_shape_fn else x.shape
+        result = jax.pure_callback(
+            host_call, jax.ShapeDtypeStruct(tuple(shape), np.float32), x)
+        return result
+
+    dispatch.register(name, kernel, amp="deny")
+
+    if grad_fn is not None:
+        import functools
+
+        @functools.wraps(kernel)
+        def kernel_vjp(x):
+            return kernel(x)
+
+        base = kernel
+
+        def kernel_with_grad(x):
+            @jax.custom_vjp
+            def f(a):
+                return base(a)
+
+            def fwd(a):
+                return base(a), a
+
+            def bwd(a, ct):
+                return (grad_fn(a, ct),)
+
+            f.defvjp(fwd, bwd)
+            return f(x)
+
+        dispatch.override(name, kernel_with_grad)
+
+    def op(x):
+        t = x if isinstance(x, Tensor) else Tensor(data=x)
+        return dispatch.call(name, t)
+
+    op.__name__ = name
+    return op

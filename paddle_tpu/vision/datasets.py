@@ -47,3 +47,90 @@ Cifar10 = MNIST
 Cifar100 = MNIST
 Flowers = MNIST
 VOC2012 = MNIST
+
+
+class DatasetFolder(Dataset):
+    """Generic folder-of-class-subfolders dataset (reference:
+    python/paddle/vision/datasets/folder.py) — fully functional offline:
+    root/class_x/xxx.ext layout, PIL-decoded samples."""
+
+    IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm",
+                      ".tif", ".tiff", ".webp", ".npy")
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self.default_loader
+        exts = tuple(e.lower() for e in (extensions
+                                         or self.IMG_EXTENSIONS))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise ValueError(f"no class folders found under {root!r}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for base, _, files in sorted(os.walk(cdir)):
+                for f in sorted(files):
+                    path = os.path.join(base, f)
+                    ok = is_valid_file(path) if is_valid_file else \
+                        f.lower().endswith(exts)
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise ValueError(f"no valid files found under {root!r}")
+
+    @staticmethod
+    def default_loader(path):
+        import numpy as np
+        if path.lower().endswith(".npy"):
+            return np.load(path)
+        from PIL import Image
+        with Image.open(path) as img:
+            return np.asarray(img.convert("RGB"))
+
+    def __getitem__(self, i):
+        path, label = self.samples[i]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, label
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat folder of images, no labels (reference: folder.py
+    ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        exts = tuple(e.lower() for e in (
+            extensions or DatasetFolder.IMG_EXTENSIONS))
+        self.loader = loader or DatasetFolder.default_loader
+        self.transform = transform
+        self.samples = []
+        for base, _, files in sorted(os.walk(root)):
+            for f in sorted(files):
+                path = os.path.join(base, f)
+                ok = is_valid_file(path) if is_valid_file else \
+                    f.lower().endswith(exts)
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise ValueError(f"no valid files found under {root!r}")
+
+    def __getitem__(self, i):
+        sample = self.loader(self.samples[i])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return (sample,)
+
+    def __len__(self):
+        return len(self.samples)

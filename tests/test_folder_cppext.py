@@ -1,0 +1,93 @@
+"""DatasetFolder/ImageFolder (real, PIL-backed) + cpp_extension custom
+ops (reference: vision/datasets/folder.py, utils/cpp_extension)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _make_image_tree(root):
+    from PIL import Image
+    for cls, n in (("cat", 3), ("dog", 2)):
+        d = os.path.join(root, cls)
+        os.makedirs(d)
+        for i in range(n):
+            arr = np.full((8, 8, 3), 40 * i, np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, f"{i}.png"))
+
+
+def test_dataset_folder(tmp_path):
+    root = str(tmp_path / "data")
+    os.makedirs(root)
+    _make_image_tree(root)
+    from paddle_tpu.vision.datasets import DatasetFolder
+    ds = DatasetFolder(root)
+    assert ds.classes == ["cat", "dog"]
+    assert len(ds) == 5
+    img, label = ds[0]
+    assert img.shape == (8, 8, 3) and label == 0
+    labels = [l for _, l in (ds[i] for i in range(len(ds)))]
+    assert labels == [0, 0, 0, 1, 1]
+
+
+def test_dataset_folder_with_transform_and_loader(tmp_path):
+    root = str(tmp_path / "data")
+    os.makedirs(root)
+    _make_image_tree(root)
+    from paddle_tpu.vision.datasets import DatasetFolder
+    from paddle_tpu.vision import transforms as T
+    ds = DatasetFolder(root, transform=T.Compose(
+        [T.ToTensor(), T.Normalize([0.5] * 3, [0.5] * 3)]))
+    img, _ = ds[1]
+    assert img.shape == [3, 8, 8]
+
+
+def test_image_folder_flat(tmp_path):
+    root = str(tmp_path / "imgs")
+    os.makedirs(root)
+    from PIL import Image
+    for i in range(4):
+        Image.fromarray(np.zeros((4, 4, 3), np.uint8)).save(
+            os.path.join(root, f"x{i}.png"))
+    from paddle_tpu.vision.datasets import ImageFolder
+    ds = ImageFolder(root)
+    assert len(ds) == 4
+    (img,) = ds[0]
+    assert img.shape == (4, 4, 3)
+
+
+def test_dataset_folder_empty_raises(tmp_path):
+    from paddle_tpu.vision.datasets import DatasetFolder
+    with pytest.raises(ValueError, match="class folders"):
+        DatasetFolder(str(tmp_path))
+
+
+def test_cpp_extension_custom_op(tmp_path):
+    from paddle_tpu.utils import cpp_extension
+    src = str(tmp_path / "cube.cc")
+    with open(src, "w") as f:
+        f.write("""
+extern "C" void cube_op(const float* x, float* out, long n) {
+  for (long i = 0; i < n; ++i) out[i] = x[i] * x[i] * x[i];
+}
+""")
+    lib = cpp_extension.load(name="cube", sources=[src],
+                             build_directory=str(tmp_path))
+    cube = cpp_extension.register_op(
+        lib, "cube_op", grad_fn=lambda a, ct: 3.0 * a * a * ct)
+    x = pt.to_tensor(np.array([1.0, 2.0, -3.0], np.float32))
+    np.testing.assert_allclose(cube(x).numpy(), [1.0, 8.0, -27.0])
+    # under jit via pure_callback
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.dispatch import call_raw
+    out = jax.jit(lambda a: call_raw("custom_cube_op", a))(
+        jnp.asarray([2.0]))
+    np.testing.assert_allclose(np.asarray(out), [8.0])
+    # tape gradient through the C kernel
+    t = pt.to_tensor(np.array([2.0], np.float32))
+    t.stop_gradient = False
+    cube(t).sum().backward()
+    np.testing.assert_allclose(t.grad.numpy(), [12.0])
