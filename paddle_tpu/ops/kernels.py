@@ -463,3 +463,27 @@ def dyn_update_seq_k(buf, val, pos):
     (reference analog: paddle's fused write_cache_kv in inference)."""
     return jax.lax.dynamic_update_slice_in_dim(
         buf, val.astype(buf.dtype), pos.astype(jnp.int32), axis=1)
+
+# ------------------------------------------------ round-2 tensor additions
+register("take_flat", lambda x, idx, mode="clip":
+         jnp.take(x.reshape(-1), idx, mode=mode))
+register("p_norm_multi", lambda x, p=2.0, axes=(), keepdim=True:
+         jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axes,
+                           keepdims=keepdim), 1.0 / p))
+register("gcd", jnp.gcd)
+register("lcm", jnp.lcm)
+register("ldexp", lambda x, e: jnp.ldexp(x, e.astype(jnp.int32)))
+register("sort_axis0", lambda x: jnp.sort(x, axis=0))
+register("moveaxis", lambda x, source, destination:
+         jnp.moveaxis(x, source, destination))
+register("tensordot", lambda x, y, axes=2: jnp.tensordot(x, y, axes=axes),
+         amp="allow")
+register("signbit", jnp.signbit)
+register("isneginf", jnp.isneginf)
+register("isposinf", jnp.isposinf)
+register("polar", lambda r, t: (r * jnp.cos(t)
+                                + 1j * (r * jnp.sin(t))).astype(
+                                    jnp.complex64))
+register("angle", jnp.angle)
+register("deg2rad", jnp.deg2rad)
+register("rad2deg", jnp.rad2deg)

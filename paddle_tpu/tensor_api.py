@@ -658,3 +658,167 @@ def matrix_power(x, n):
 
 def trace(x, offset=0, axis1=0, axis2=1):
     return ops.call("trace_op", _t(x), offset=offset, axis1=axis1, axis2=axis2)
+
+
+# ------------------------------------------------ round-2 tensor additions
+def trapezoid(y, x=None, dx=None, axis=-1):
+    ya = _t(y)._array
+    if x is not None:
+        return Tensor._from_array(
+            jnp.trapezoid(ya, _t(x)._array, axis=axis))
+    return Tensor._from_array(
+        jnp.trapezoid(ya, dx=1.0 if dx is None else dx, axis=axis))
+
+
+def nanquantile(x, q, axis=None, keepdim=False):
+    return Tensor._from_array(jnp.nanquantile(
+        _t(x)._array, q, axis=axis, keepdims=keepdim))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(_t(sorted_sequence)._array, _t(x)._array,
+                           side=side)
+    # int64 requests resolve to int32 package-wide (x64 disabled on TPU)
+    return Tensor._from_array(out.astype(jnp.int32))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None):
+    """Eager-only (data-dependent output shape, like the reference)."""
+    import numpy as np
+    arr = np.asarray(_t(x)._array)
+    if axis is None:
+        arr = arr.reshape(-1)
+    keep = np.ones(arr.shape[0 if axis is None else axis], bool)
+    cmp = arr if axis is None else np.moveaxis(arr, axis, 0)
+    same = (cmp[1:] == cmp[:-1])
+    while same.ndim > 1:
+        same = same.all(axis=-1)
+    keep[1:] = ~same
+    idx = np.nonzero(keep)[0]
+    out = cmp[idx] if axis is None else np.moveaxis(cmp[idx], 0, axis)
+    res = [Tensor._from_array(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        res.append(Tensor._from_array(jnp.asarray(inv)))
+    if return_counts:
+        counts = np.diff(np.append(idx, len(keep)))
+        res.append(Tensor._from_array(jnp.asarray(counts)))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+def take(x, index, mode="raise"):
+    xt = _t(x)
+    idx = _t(index)._array
+    if mode == "raise":
+        # eager host-side bounds check (a traced program cannot raise;
+        # there the clamp applies, like the reference's GPU behavior)
+        import numpy as np
+        if not isinstance(idx, jax.core.Tracer):
+            host = np.asarray(idx)
+            if host.size and (host.min() < -xt.size
+                              or host.max() >= xt.size):
+                raise IndexError(
+                    f"take index out of range for tensor of {xt.size} "
+                    "elements")
+    m = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    return ops.call("take_flat", xt, idx=idx, mode=m)
+
+
+def renorm(x, p, axis, max_norm):
+    xa = _t(x)
+    axis = axis % xa.ndim
+    dims = [d for d in range(xa.ndim) if d != axis]
+    norms = ops.call("p_norm_multi", xa, p=builtins.float(p),
+                     axes=tuple(dims), keepdim=True)
+    factor = (max_norm / norms.clip(min=1e-7)).clip(max=1.0)
+    return xa * factor
+
+
+def gcd(x, y):
+    return ops.call("gcd", _t(x), _t(y))
+
+
+def lcm(x, y):
+    return ops.call("lcm", _t(x), _t(y))
+
+
+def frexp(x):
+    m, e = jnp.frexp(_t(x)._array)
+    return Tensor._from_array(m), Tensor._from_array(e)
+
+
+def ldexp(x, y):
+    return ops.call("ldexp", _t(x), _t(y))
+
+
+def vander(x, n=None, increasing=False):
+    return Tensor._from_array(jnp.vander(
+        _t(x)._array, N=n, increasing=increasing))
+
+
+def msort(x):
+    return ops.call("sort_axis0", _t(x))
+
+
+def view_as(x, other):
+    return _t(x).reshape(list(_t(other).shape))
+
+
+def unflatten(x, axis, shape):
+    xa = _t(x)
+    axis = axis % xa.ndim
+    new = list(xa.shape[:axis]) + list(shape) + list(xa.shape[axis + 1:])
+    return xa.reshape(new)
+
+
+def moveaxis(x, source, destination):
+    return ops.call("moveaxis", _t(x), source=source,
+                    destination=destination)
+
+
+def tensordot(x, y, axes=2):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a
+                     for a in axes)
+    return ops.call("tensordot", _t(x), _t(y), axes=axes)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    import numpy as np
+    arr = np.asarray(_t(x)._array)
+    h, edges = np.histogramdd(
+        arr, bins=bins, range=ranges, density=density,
+        weights=None if weights is None else np.asarray(
+            _t(weights)._array))
+    return (Tensor._from_array(jnp.asarray(h)),
+            [Tensor._from_array(jnp.asarray(e)) for e in edges])
+
+
+def signbit(x):
+    return ops.call("signbit", _t(x))
+
+
+def isneginf(x):
+    return ops.call("isneginf", _t(x))
+
+
+def isposinf(x):
+    return ops.call("isposinf", _t(x))
+
+
+def polar(abs, angle):
+    return ops.call("polar", _t(abs), _t(angle))
+
+
+def angle(x):
+    return ops.call("angle", _t(x))
+
+
+def deg2rad(x):
+    return ops.call("deg2rad", _t(x))
+
+
+def rad2deg(x):
+    return ops.call("rad2deg", _t(x))

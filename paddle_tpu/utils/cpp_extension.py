@@ -82,29 +82,12 @@ def register_op(lib, fn_name, op_name=None, out_shape_fn=None,
     dispatch.register(name, kernel, amp="deny")
 
     if grad_fn is not None:
-        import functools
-
-        @functools.wraps(kernel)
-        def kernel_vjp(x):
-            return kernel(x)
-
-        base = kernel
-
-        def kernel_with_grad(x):
-            @jax.custom_vjp
-            def f(a):
-                return base(a)
-
-            def fwd(a):
-                return base(a), a
-
-            def bwd(a, ct):
-                return (grad_fn(a, ct),)
-
-            f.defvjp(fwd, bwd)
-            return f(x)
-
-        dispatch.override(name, kernel_with_grad)
+        # build the custom_vjp wrapper ONCE at registration (a per-call
+        # rebuild would defeat jax's function-identity caching)
+        f = jax.custom_vjp(kernel)
+        f.defvjp(lambda a: (kernel(a), a),
+                 lambda a, ct: (grad_fn(a, ct),))
+        dispatch.override(name, f)
 
     def op(x):
         t = x if isinstance(x, Tensor) else Tensor(data=x)

@@ -49,6 +49,24 @@ Flowers = MNIST
 VOC2012 = MNIST
 
 
+def _scan_files(root, extensions, is_valid_file):
+    """Walk `root` collecting files matching the extension/predicate
+    filter (shared by DatasetFolder and ImageFolder)."""
+    import os
+    if not os.path.isdir(root):
+        raise FileNotFoundError(f"dataset root {root!r} does not exist")
+    exts = tuple(e.lower() for e in extensions)
+    found = []
+    for base, _, files in sorted(os.walk(root)):
+        for f in sorted(files):
+            path = os.path.join(base, f)
+            ok = is_valid_file(path) if is_valid_file else \
+                f.lower().endswith(exts)
+            if ok:
+                found.append(path)
+    return found
+
+
 class DatasetFolder(Dataset):
     """Generic folder-of-class-subfolders dataset (reference:
     python/paddle/vision/datasets/folder.py) — fully functional offline:
@@ -63,8 +81,6 @@ class DatasetFolder(Dataset):
         self.root = root
         self.transform = transform
         self.loader = loader or self.default_loader
-        exts = tuple(e.lower() for e in (extensions
-                                         or self.IMG_EXTENSIONS))
         classes = sorted(d for d in os.listdir(root)
                          if os.path.isdir(os.path.join(root, d)))
         if not classes:
@@ -73,14 +89,10 @@ class DatasetFolder(Dataset):
         self.class_to_idx = {c: i for i, c in enumerate(classes)}
         self.samples = []
         for c in classes:
-            cdir = os.path.join(root, c)
-            for base, _, files in sorted(os.walk(cdir)):
-                for f in sorted(files):
-                    path = os.path.join(base, f)
-                    ok = is_valid_file(path) if is_valid_file else \
-                        f.lower().endswith(exts)
-                    if ok:
-                        self.samples.append((path, self.class_to_idx[c]))
+            for path in _scan_files(os.path.join(root, c),
+                                    extensions or self.IMG_EXTENSIONS,
+                                    is_valid_file):
+                self.samples.append((path, self.class_to_idx[c]))
         if not self.samples:
             raise ValueError(f"no valid files found under {root!r}")
 
@@ -110,19 +122,11 @@ class ImageFolder(Dataset):
 
     def __init__(self, root, loader=None, extensions=None, transform=None,
                  is_valid_file=None):
-        import os
-        exts = tuple(e.lower() for e in (
-            extensions or DatasetFolder.IMG_EXTENSIONS))
         self.loader = loader or DatasetFolder.default_loader
         self.transform = transform
-        self.samples = []
-        for base, _, files in sorted(os.walk(root)):
-            for f in sorted(files):
-                path = os.path.join(base, f)
-                ok = is_valid_file(path) if is_valid_file else \
-                    f.lower().endswith(exts)
-                if ok:
-                    self.samples.append(path)
+        self.samples = _scan_files(
+            root, extensions or DatasetFolder.IMG_EXTENSIONS,
+            is_valid_file)
         if not self.samples:
             raise ValueError(f"no valid files found under {root!r}")
 

@@ -1,0 +1,98 @@
+"""Round-2 tensor-API additions vs numpy (SURVEY §2 Tensor methods)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import tensor_api as T
+
+
+def _x(seed=0, shape=(3, 4)):
+    return pt.to_tensor(np.random.RandomState(seed).randn(
+        *shape).astype(np.float32))
+
+
+def test_trapezoid_nanquantile_bucketize():
+    x = _x()
+    np.testing.assert_allclose(T.trapezoid(x).numpy(),
+                               np.trapezoid(x.numpy(), axis=-1), rtol=1e-5)
+    assert T.nanquantile(x, 0.5).shape == []
+    b = T.bucketize(pt.to_tensor(np.array([0.1, 2.5], np.float32)),
+                    pt.to_tensor(np.array([0., 1., 2., 3.], np.float32)))
+    np.testing.assert_array_equal(b.numpy(), [1, 3])
+
+
+def test_unique_consecutive():
+    u, inv, cnt = T.unique_consecutive(
+        pt.to_tensor(np.array([1, 1, 2, 2, 2, 3, 1], np.int32)),
+        return_inverse=True, return_counts=True)
+    np.testing.assert_array_equal(u.numpy(), [1, 2, 3, 1])
+    np.testing.assert_array_equal(inv.numpy(), [0, 0, 1, 1, 1, 2, 3])
+    np.testing.assert_array_equal(cnt.numpy(), [2, 3, 1, 1])
+
+
+def test_take_renorm_msort():
+    x = _x()
+    np.testing.assert_array_equal(
+        T.take(x, pt.to_tensor(np.array([0, 5], np.int32))).numpy(),
+        x.numpy().reshape(-1)[[0, 5]])
+    r = T.renorm(x, p=2.0, axis=0, max_norm=1.0)
+    assert np.linalg.norm(r.numpy(), axis=1).max() <= 1.0 + 1e-5
+    np.testing.assert_array_equal(T.msort(x).numpy(),
+                                  np.sort(x.numpy(), axis=0))
+
+
+def test_int_and_float_bit_ops():
+    np.testing.assert_array_equal(
+        T.gcd(pt.to_tensor(np.array([12], np.int32)),
+              pt.to_tensor(np.array([18], np.int32))).numpy(), [6])
+    np.testing.assert_array_equal(
+        T.lcm(pt.to_tensor(np.array([4], np.int32)),
+              pt.to_tensor(np.array([6], np.int32))).numpy(), [12])
+    m, e = T.frexp(pt.to_tensor(np.array([8.0], np.float32)))
+    np.testing.assert_allclose(m.numpy() * 2.0 ** e.numpy(), [8.0])
+    np.testing.assert_allclose(
+        T.ldexp(pt.to_tensor(np.array([1.5], np.float32)),
+                pt.to_tensor(np.array([3], np.int32))).numpy(), [12.0])
+    assert T.signbit(pt.to_tensor(
+        np.array([-1.0, 2.0], np.float32))).numpy().tolist() == [True, False]
+
+
+def test_shape_manipulation():
+    x = _x()
+    assert T.view_as(x, pt.zeros([4, 3])).shape == [4, 3]
+    assert T.unflatten(pt.zeros([2, 12]), 1, [3, 4]).shape == [2, 3, 4]
+    assert T.moveaxis(pt.zeros([2, 3, 4]), 0, -1).shape == [3, 4, 2]
+    assert T.vander(pt.to_tensor(
+        np.array([1.0, 2.0], np.float32))).shape == [2, 2]
+
+
+def test_tensordot_grad_and_histogramdd():
+    rng = np.random.RandomState(1)
+    g = pt.to_tensor(rng.randn(3, 4).astype(np.float32))
+    g.stop_gradient = False
+    y = pt.to_tensor(rng.randn(4, 2).astype(np.float32))
+    out = T.tensordot(g, y, axes=1)
+    assert out.shape == [3, 2]
+    out.sum().backward()
+    np.testing.assert_allclose(g.grad.numpy(),
+                               np.tile(y.numpy().sum(1), (3, 1)),
+                               rtol=1e-5)
+    h, edges = T.histogramdd(pt.to_tensor(
+        rng.randn(20, 2).astype(np.float32)), bins=4)
+    assert h.shape == [4, 4] and len(edges) == 2
+    assert float(h.numpy().sum()) == 20.0
+
+
+def test_complex_and_angles():
+    p = T.polar(pt.to_tensor(np.array([1.0], np.float32)),
+                pt.to_tensor(np.array([np.pi / 2], np.float32)))
+    np.testing.assert_allclose(p.numpy().imag, [1.0], atol=1e-6)
+    np.testing.assert_allclose(T.angle(p).numpy(), [np.pi / 2], rtol=1e-5)
+    np.testing.assert_allclose(
+        T.deg2rad(pt.to_tensor(np.array([180.0], np.float32))).numpy(),
+        [np.pi], rtol=1e-6)
+    np.testing.assert_allclose(
+        T.rad2deg(pt.to_tensor(np.array([np.pi], np.float32))).numpy(),
+        [180.0], rtol=1e-6)
+    assert T.isneginf(pt.to_tensor(
+        np.array([-np.inf, 1.0], np.float32))).numpy().tolist() == \
+        [True, False]
