@@ -46,13 +46,19 @@ class MultiHeadAttention(Layer):
         key = query if key is None else key
         value = query if value is None else value
         q = self._shape(self.q_proj(query))
-        k = self._shape(self.k_proj(key))
-        v = self._shape(self.v_proj(value))
-        if cache is not None:
-            from .. import tensor_api as T
-            k = T.concat([cache.k, k], axis=1)
-            v = T.concat([cache.v, v], axis=1)
-            new_cache = self.Cache(k, v)
+        if isinstance(cache, self.StaticCache):
+            # cross-attention: k/v were projected ONCE from the encoder
+            # memory (gen_cache); skip the per-step projections entirely
+            k, v = cache.k, cache.v
+            new_cache = cache
+        else:
+            k = self._shape(self.k_proj(key))
+            v = self._shape(self.v_proj(value))
+            if cache is not None:
+                from .. import tensor_api as T
+                k = T.concat([cache.k, k], axis=1)
+                v = T.concat([cache.v, v], axis=1)
+                new_cache = self.Cache(k, v)
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask,
             dropout_p=self.dropout, training=self.training)
@@ -65,6 +71,11 @@ class MultiHeadAttention(Layer):
 
     def gen_cache(self, key, value=None, type=None):
         from .. import tensor_api as T
+        if type is self.StaticCache:
+            # precompute the cross-attn k/v from the encoder memory
+            value = key if value is None else value
+            return self.StaticCache(self._shape(self.k_proj(key)),
+                                    self._shape(self.v_proj(value)))
         b = key.shape[0]
         k = T.zeros([b, 0, self.num_heads, self.head_dim],
                     dtype=key._array.dtype)
@@ -151,19 +162,37 @@ class TransformerDecoderLayer(Layer):
         self.dropout3 = Dropout(dropout)
         self.activation = getattr(F, activation)
 
+    def gen_cache(self, memory):
+        """(incremental self-attn Cache, static cross-attn cache) pair
+        (reference: TransformerDecoderLayer.gen_cache)."""
+        inc = self.self_attn.gen_cache(memory, type=MultiHeadAttention.Cache)
+        static = self.cross_attn.gen_cache(memory, memory,
+                                           MultiHeadAttention.StaticCache)
+        return inc, static
+
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
                 cache=None):
+        new_cache = None
         residual = tgt
         if self.normalize_before:
             tgt = self.norm1(tgt)
-        tgt = self.self_attn(tgt, tgt, tgt, tgt_mask)
+        if cache is not None:
+            tgt, inc = self.self_attn(tgt, tgt, tgt, tgt_mask,
+                                      cache=cache[0])
+            new_cache = (inc, cache[1])
+        else:
+            tgt = self.self_attn(tgt, tgt, tgt, tgt_mask)
         tgt = residual + self.dropout1(tgt)
         if not self.normalize_before:
             tgt = self.norm1(tgt)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm2(tgt)
-        tgt = self.cross_attn(tgt, memory, memory, memory_mask)
+        if cache is not None:
+            tgt, _ = self.cross_attn(tgt, memory, memory, memory_mask,
+                                     cache=cache[1])
+        else:
+            tgt = self.cross_attn(tgt, memory, memory, memory_mask)
         tgt = residual + self.dropout2(tgt)
         if not self.normalize_before:
             tgt = self.norm2(tgt)
@@ -174,7 +203,7 @@ class TransformerDecoderLayer(Layer):
         tgt = residual + self.dropout3(tgt)
         if not self.normalize_before:
             tgt = self.norm3(tgt)
-        return tgt
+        return tgt if new_cache is None else (tgt, new_cache)
 
 
 class TransformerDecoder(Layer):
@@ -186,13 +215,23 @@ class TransformerDecoder(Layer):
                                for _ in range(num_layers - 1)])
         self.norm = norm
 
-    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None):
+    def gen_cache(self, memory):
+        return [layer.gen_cache(memory) for layer in self.layers]
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
         out = tgt
-        for layer in self.layers:
-            out = layer(out, memory, tgt_mask, memory_mask)
+        new_caches = [] if cache is not None else None
+        for i, layer in enumerate(self.layers):
+            if cache is not None:
+                out, c = layer(out, memory, tgt_mask, memory_mask,
+                               cache=cache[i])
+                new_caches.append(c)
+            else:
+                out = layer(out, memory, tgt_mask, memory_mask)
         if self.norm is not None:
             out = self.norm(out)
-        return out
+        return out if cache is None else (out, new_caches)
 
 
 class Transformer(Layer):

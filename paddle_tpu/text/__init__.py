@@ -17,3 +17,6 @@ from .ernie import (  # noqa: F401
     ERNIE3_PRESETS,
 )
 from .generation import generate, beam_search  # noqa: F401
+from .transformer_mt import (  # noqa: F401
+    TransformerModel, transformer_mt_loss, sinusoidal_positions,
+)

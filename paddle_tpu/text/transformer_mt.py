@@ -1,0 +1,166 @@
+"""Seq2seq Transformer for machine translation (reference analog:
+PaddleNLP's transformer MT example — the classic nn.Transformer
+demo: token+sinusoidal-position embeddings, causal decoder, tied or
+separate generator head, greedy decode).
+
+TPU-native: everything static-shaped; the greedy decode encodes once and
+steps the decoder incrementally through per-layer KV caches (self-attn
+Cache + cross-attn StaticCache — nn/transformer.py), so each token costs
+one single-query decoder pass instead of a full-prefix re-run.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from ..tensor import Tensor
+
+
+def sinusoidal_positions(max_len, d_model):
+    """Standard sin/cos table [max_len, d_model] (host-computed once)."""
+    pos = np.arange(max_len)[:, None].astype(np.float64)
+    dim = np.arange(0, d_model, 2).astype(np.float64)
+    div = np.exp(-math.log(10000.0) * dim / d_model)
+    table = np.zeros((max_len, d_model), np.float32)
+    table[:, 0::2] = np.sin(pos * div)
+    # odd d_model: the cos half has one column fewer
+    table[:, 1::2] = np.cos(pos * div)[:, :d_model // 2]
+    return table
+
+
+class TransformerModel(nn.Layer):
+    """Encoder-decoder MT model (reference: the transformer example's
+    TransformerModel): returns [b, tgt_len, trg_vocab] logits."""
+
+    def __init__(self, src_vocab_size, trg_vocab_size, max_length=256,
+                 d_model=512, n_head=8, num_encoder_layers=6,
+                 num_decoder_layers=6, d_inner_hid=2048, dropout=0.1,
+                 weight_sharing=False, bos_id=0, eos_id=1):
+        super().__init__()
+        self.d_model = d_model
+        self.bos_id, self.eos_id = bos_id, eos_id
+        init = nn.initializer.Normal(0.0, d_model ** -0.5)
+        self.src_embed = nn.Embedding(src_vocab_size, d_model,
+                                      weight_attr=init)
+        if weight_sharing:
+            if src_vocab_size != trg_vocab_size:
+                raise ValueError(
+                    "weight_sharing requires equal src/trg vocab sizes")
+            self.trg_embed = self.src_embed
+        else:
+            self.trg_embed = nn.Embedding(trg_vocab_size, d_model,
+                                          weight_attr=init)
+        self.register_buffer(
+            "pos_table", Tensor(sinusoidal_positions(max_length, d_model)),
+            persistable=False)
+        self.dropout = nn.Dropout(dropout)
+        self.transformer = nn.Transformer(
+            d_model=d_model, nhead=n_head,
+            num_encoder_layers=num_encoder_layers,
+            num_decoder_layers=num_decoder_layers,
+            dim_feedforward=d_inner_hid, dropout=dropout,
+            activation="relu", normalize_before=True)
+        self.weight_sharing = weight_sharing
+        if not weight_sharing:
+            self.generator = nn.Linear(d_model, trg_vocab_size)
+
+    def _embed(self, table, ids, offset=0):
+        s = ids.shape[1]
+        if offset + s > self.pos_table.shape[0]:
+            raise ValueError(
+                f"sequence length {offset + s} exceeds the model's "
+                f"max_length {self.pos_table.shape[0]}")
+        x = table(ids) * (self.d_model ** 0.5)
+        return self.dropout(x + self.pos_table[offset:offset + s])
+
+    @staticmethod
+    def _causal_mask(s):
+        import jax.numpy as jnp
+        m = jnp.triu(jnp.full((s, s), -1e9, jnp.float32), k=1)
+        return Tensor._from_array(m[None, None])
+
+    def _pad_mask(self, ids, pad_id):
+        # [b, 1, 1, s] additive mask: -1e9 on pad positions
+        m = (ids == pad_id).astype("float32") * -1e9
+        return m.unsqueeze(1).unsqueeze(1)
+
+    def forward(self, src_word, trg_word, src_pad_id=None):
+        src_mask = None if src_pad_id is None else \
+            self._pad_mask(src_word, src_pad_id)
+        tgt_mask = self._causal_mask(trg_word.shape[1])
+        out = self.transformer(
+            self._embed(self.src_embed, src_word),
+            self._embed(self.trg_embed, trg_word),
+            src_mask=src_mask, tgt_mask=tgt_mask, memory_mask=src_mask)
+        if self.weight_sharing:
+            return out.matmul(self.trg_embed.weight, transpose_y=True)
+        return self.generator(out)
+
+    # --------------------------------------------------------- inference
+    def generate(self, src_word, max_length=32, src_pad_id=None):
+        """Greedy decode with incremental KV caches: the encoder runs
+        once, each step feeds only the newest token (self-attn reads the
+        cached keys/values; cross-attn k/v are projected once from the
+        memory).  Runs in eval mode under no_grad; eos rows keep
+        emitting eos.  The early-exit is an eager host check, skipped
+        when tracing (a traced program runs the full max_length loop)."""
+        import jax
+        from .. import tensor_api as T
+        from ..autograd import engine
+        limit = self.pos_table.shape[0]
+        if max_length > limit:
+            raise ValueError(
+                f"generate(max_length={max_length}) exceeds the model's "
+                f"positional table ({limit}); rebuild with a larger "
+                "max_length")
+        was_training = self.training
+        self.eval()
+        try:
+            with engine.no_grad():
+                b = src_word.shape[0]
+                src_mask = None if src_pad_id is None else \
+                    self._pad_mask(src_word, src_pad_id)
+                memory = self.transformer.encoder(
+                    self._embed(self.src_embed, src_word), src_mask)
+                caches = self.transformer.decoder.gen_cache(memory)
+                out = T.full([b, 1], self.bos_id, dtype="int32")
+                finished = T.zeros([b], dtype="bool")
+                cur = out
+                for step in range(max_length):
+                    dec, caches = self.transformer.decoder(
+                        self._embed(self.trg_embed, cur, offset=step),
+                        memory, None, src_mask, cache=caches)
+                    logits = (dec[:, -1].matmul(self.trg_embed.weight,
+                                                transpose_y=True)
+                              if self.weight_sharing
+                              else self.generator(dec[:, -1]))
+                    nxt = T.argmax(logits, axis=-1).astype("int32")
+                    nxt = T.where(finished, T.full_like(nxt, self.eos_id),
+                                  nxt)
+                    finished = finished | (nxt == self.eos_id)
+                    cur = nxt.unsqueeze(1)
+                    out = T.concat([out, cur], axis=1)
+                    if not isinstance(finished._array, jax.core.Tracer) \
+                            and bool(finished.all()):
+                        break
+                return out
+        finally:
+            if was_training:
+                self.train()
+
+
+def transformer_mt_loss(model, src, trg, label_smooth_eps=0.1,
+                        pad_id=None):
+    """Teacher-forced MT loss: predict trg[1:] from trg[:-1] with label
+    smoothing (reference: the transformer example's CrossEntropyCriterion)."""
+    logits = model(src, trg[:, :-1], src_pad_id=pad_id)
+    labels = trg[:, 1:]
+    loss = F.cross_entropy(logits, labels, reduction="none",
+                           label_smoothing=label_smooth_eps)
+    if pad_id is not None:
+        mask = (labels != pad_id).astype(loss.dtype)
+        return (loss * mask).sum() / mask.sum().clip(min=1.0)
+    return loss.mean()
