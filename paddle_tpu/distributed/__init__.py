@@ -24,6 +24,7 @@ from .ring_attention import ring_attention, ring_attention_local  # noqa: F401
 from .pipeline import PipelineLayer, gpipe_spmd, pipeline_apply  # noqa: F401
 from .fleet_engine import DistributedTrainStep  # noqa: F401
 from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     ProcessMesh, Placement, Shard, Replicate, Partial, shard_tensor,

@@ -5,4 +5,6 @@ lives here the way the reference keeps it under
 paddle.incubate.distributed.models.moe.
 """
 from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
 from .nn.moe import MoELayer, moe_aux_loss  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401

@@ -1,0 +1,115 @@
+"""paddle.incubate.optimizer analog — LookAhead and ModelAverage wrapper
+optimizers (reference: python/paddle/incubate/optimizer/{lookahead,
+modelaverage}.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+
+class LookAhead:
+    """Wraps an inner optimizer: every k steps the slow weights move
+    alpha of the way toward the fast weights and the fast weights reset
+    to them (Zhang et al. 2019; reference: incubate LookAhead)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        if not (0.0 <= alpha <= 1.0):
+            raise ValueError("alpha must be in [0, 1]")
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._slow = None
+        self._steps = 0
+        self._parameters = inner_optimizer._parameters
+
+    def step(self):
+        if self._slow is None:
+            # slow weights start at the INITIAL parameters (snapshot
+            # before the first fast step); explicit copies because the
+            # inner optimizer's jitted step DONATES the param buffers
+            self._slow = [jnp.array(p._array, jnp.float32, copy=True)
+                          for p in self._parameters]
+        self.inner_optimizer.step()
+        self._steps += 1
+        if self._steps % self.k == 0:
+            for p, s in zip(self._parameters, self._slow):
+                new_slow = s + self.alpha * (
+                    p._array.astype(jnp.float32) - s)
+                p._inplace_assign(new_slow.astype(p._array.dtype))
+            self._slow = [jnp.array(p._array, jnp.float32, copy=True)
+                          for p in self._parameters]
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        out = self.inner_optimizer.state_dict()
+        if self._slow is not None:
+            for i, s in enumerate(self._slow):
+                out[f"__lookahead__/slow{i}"] = Tensor._from_array(s)
+        out["__lookahead__/steps"] = self._steps
+        return out
+
+    def set_state_dict(self, state):
+        self._steps = int(state.get("__lookahead__/steps", 0))
+        slow = []
+        i = 0
+        while f"__lookahead__/slow{i}" in state:
+            v = state[f"__lookahead__/slow{i}"]
+            slow.append(v._array if isinstance(v, Tensor)
+                        else jnp.asarray(v))
+            i += 1
+        self._slow = slow or None
+        self.inner_optimizer.set_state_dict(
+            {k: v for k, v in state.items()
+             if not k.startswith("__lookahead__/")})
+
+
+class ModelAverage:
+    """Maintains an exponential/window average of the parameters;
+    apply()/restore() swap the averaged weights in and out for
+    evaluation (reference: incubate ModelAverage)."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000):
+        if parameters is None:
+            raise ValueError("parameters must be provided")
+        self._parameters = list(parameters)
+        # explicit copies: eager optimizer steps donate param buffers
+        self._avg = [jnp.array(p._array, jnp.float32, copy=True)
+                     for p in self._parameters]
+        self._n = 1
+        self._backup = None
+
+    def step(self):
+        """Accumulate the running average (call after optimizer.step)."""
+        self._n += 1
+        for i, p in enumerate(self._parameters):
+            self._avg[i] = self._avg[i] + (
+                p._array.astype(jnp.float32) - self._avg[i]) / self._n
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged weights in (stash the current ones)."""
+        if need_restore:
+            self._backup = [jnp.array(p._array, copy=True)
+                            for p in self._parameters]
+        for p, a in zip(self._parameters, self._avg):
+            p._inplace_assign(a.astype(p._array.dtype))
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            raise RuntimeError("restore() without a prior apply()")
+        for p, b in zip(self._parameters, self._backup):
+            p._inplace_assign(b)
+        self._backup = None

@@ -158,9 +158,8 @@ def transformer_mt_loss(model, src, trg, label_smooth_eps=0.1,
     smoothing (reference: the transformer example's CrossEntropyCriterion)."""
     logits = model(src, trg[:, :-1], src_pad_id=pad_id)
     labels = trg[:, 1:]
-    loss = F.cross_entropy(logits, labels, reduction="none",
-                           label_smoothing=label_smooth_eps)
-    if pad_id is not None:
-        mask = (labels != pad_id).astype(loss.dtype)
-        return (loss * mask).sum() / mask.sum().clip(min=1.0)
-    return loss.mean()
+    # cross_entropy's mean already averages over non-ignored positions
+    return F.cross_entropy(
+        logits, labels, reduction="mean",
+        ignore_index=-100 if pad_id is None else pad_id,
+        label_smoothing=label_smooth_eps)

@@ -1,0 +1,90 @@
+"""incubate LookAhead/ModelAverage + distributed.sharding shim."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate import LookAhead, ModelAverage
+
+
+def test_lookahead_converges_and_interpolates():
+    pt.seed(0)
+    w = pt.to_tensor(np.array([4.0, -3.0], np.float32))
+    w.stop_gradient = False
+    inner = pt.optimizer.SGD(learning_rate=0.2, parameters=[w])
+    opt = LookAhead(inner, alpha=0.5, k=3)
+    for _ in range(40):
+        loss = (w ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float((w ** 2).sum()) < 1e-3
+    sd = opt.state_dict()
+    assert any(k.startswith("__lookahead__/slow") for k in sd)
+    opt2 = LookAhead(pt.optimizer.SGD(learning_rate=0.2, parameters=[w]),
+                     alpha=0.5, k=3)
+    opt2.set_state_dict(sd)
+    assert opt2._steps == opt._steps
+
+
+def test_lookahead_slow_weight_math():
+    """After exactly k fast steps, weights = slow + alpha*(fast - slow)."""
+    pt.seed(1)
+    w = pt.to_tensor(np.array([1.0], np.float32))
+    w.stop_gradient = False
+    inner = pt.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    opt = LookAhead(inner, alpha=0.5, k=2)
+    w0 = w.numpy().copy()
+    fast = w0.copy()
+    for _ in range(2):   # grad of w^2 is 2w
+        fast = fast - 0.1 * 2 * fast
+        loss = (w ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    want = w0 + 0.5 * (fast - w0)
+    np.testing.assert_allclose(w.numpy(), want, rtol=1e-5)
+
+
+def test_model_average_apply_restore():
+    pt.seed(2)
+    w = pt.to_tensor(np.array([10.0], np.float32))
+    w.stop_gradient = False
+    opt = pt.optimizer.SGD(learning_rate=0.3, parameters=[w])
+    ma = ModelAverage(parameters=[w])
+    vals = [w.numpy()[0]]
+    for _ in range(5):
+        loss = (w ** 2).sum()
+        loss.backward()
+        opt.step(); opt.clear_grad()
+        ma.step()
+        vals.append(w.numpy()[0])
+    cur = w.numpy().copy()
+    ma.apply()
+    np.testing.assert_allclose(w.numpy(), np.mean(vals), rtol=1e-5)
+    ma.restore()
+    np.testing.assert_allclose(w.numpy(), cur)
+    with pytest.raises(RuntimeError, match="apply"):
+        ma.restore()
+
+
+def test_group_sharded_parallel_configures_fleet():
+    from paddle_tpu.distributed import fleet, mesh as mesh_mod
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+    prev = dict(mesh_mod._state)
+    try:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 1,
+                                   "pp_degree": 1, "sharding_degree": 4}
+        fleet.init(is_collective=True, strategy=strategy)
+        m = nn.Linear(8, 8)
+        opt = pt.optimizer.Adam(learning_rate=0.01,
+                                parameters=m.parameters())
+        m2, o2, _ = group_sharded_parallel(m, opt, "os_g")
+        assert strategy.hybrid_configs["sharding_stage"] == 2
+        with pytest.raises(ValueError, match="level"):
+            group_sharded_parallel(m, opt, "bogus")
+        with pytest.raises(NotImplementedError, match="offload"):
+            group_sharded_parallel(m, opt, "os", offload=True)
+    finally:
+        mesh_mod._state.update(prev)
