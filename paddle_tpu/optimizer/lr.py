@@ -273,3 +273,47 @@ class CosineAnnealingWarmRestarts(LRScheduler):
             t_i *= self.T_mult
         return self.eta_min + (self.base_lr - self.eta_min) * (
             1 + math.cos(math.pi * t / t_i)) / 2
+
+
+class CyclicLR(LRScheduler):
+    """reference: python/paddle/optimizer/lr.py CyclicLR (triangular /
+    triangular2 / exp_range policies over a base↔max cycle)."""
+
+    def __init__(self, base_learning_rate, max_learning_rate,
+                 step_size_up, step_size_down=None, mode="triangular",
+                 exp_gamma=1.0, scale_fn=None, scale_mode="cycle",
+                 last_epoch=-1, verbose=False):
+        self.base_lr = base_learning_rate
+        self.max_lr = max_learning_rate
+        self.up = int(step_size_up)
+        self.down = int(step_size_down
+                        if step_size_down is not None else step_size_up)
+        if self.up <= 0 or self.down <= 0:
+            raise ValueError("step_size_up/step_size_down must be positive")
+        self.mode = mode
+        self.exp_gamma = exp_gamma
+        self.scale_fn = scale_fn
+        self.scale_mode = scale_mode if scale_fn is not None else {
+            "triangular": "cycle", "triangular2": "cycle",
+            "exp_range": "iterations"}.get(mode, "cycle")
+        super().__init__(base_learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        total = self.up + self.down
+        it = self.last_epoch
+        cycle = it // total
+        pos = it - cycle * total
+        x = pos / self.up if pos <= self.up else \
+            1.0 - (pos - self.up) / self.down
+        if self.scale_fn is not None:
+            scale = self.scale_fn(cycle + 1 if self.scale_mode == "cycle"
+                                  else it)
+        elif self.mode == "triangular":
+            scale = 1.0
+        elif self.mode == "triangular2":
+            scale = 1.0 / (2.0 ** cycle)
+        elif self.mode == "exp_range":
+            scale = self.exp_gamma ** it
+        else:
+            raise ValueError(f"unknown CyclicLR mode {self.mode!r}")
+        return self.base_lr + (self.max_lr - self.base_lr) * x * scale

@@ -1,0 +1,54 @@
+"""Orthogonal/Dirac initializers + CyclicLR (reference:
+nn/initializer/{orthogonal,dirac}.py, optimizer/lr.py CyclicLR)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_orthogonal_rows_orthonormal():
+    pt.seed(0)
+    p = pt.parameter(np.zeros((8, 8), np.float32))
+    nn.initializer.Orthogonal()(p)
+    np.testing.assert_allclose(p.numpy() @ p.numpy().T, np.eye(8),
+                               atol=1e-5)
+    tall = pt.parameter(np.zeros((4, 16), np.float32))
+    nn.initializer.Orthogonal(gain=2.0)(tall)
+    np.testing.assert_allclose(tall.numpy() @ tall.numpy().T,
+                               4.0 * np.eye(4), atol=1e-4)
+
+
+def test_dirac_identity_conv():
+    w = pt.parameter(np.zeros((3, 3, 3, 3), np.float32))
+    nn.initializer.Dirac()(w)
+    x = pt.randn([1, 3, 6, 6])
+    np.testing.assert_allclose(F.conv2d(x, w, padding=1).numpy(),
+                               x.numpy(), atol=1e-6)
+
+
+def test_cyclic_lr_policies():
+    sch = pt.optimizer.lr.CyclicLR(base_learning_rate=0.1,
+                                   max_learning_rate=0.5, step_size_up=4)
+    lrs = []
+    for _ in range(16):
+        lrs.append(sch())
+        sch.step()
+    assert abs(lrs[0] - 0.1) < 1e-6
+    assert abs(max(lrs) - 0.5) < 1e-6
+    assert abs(lrs[8] - 0.1) < 1e-6  # cycle restarts at base
+
+    sch2 = pt.optimizer.lr.CyclicLR(0.1, 0.5, 2, mode="triangular2")
+    peaks = []
+    for _ in range(12):
+        peaks.append(sch2())
+        sch2.step()
+    # second cycle's peak is half the first amplitude
+    assert abs(peaks[2] - 0.5) < 1e-6
+    assert abs(peaks[6] - 0.3) < 1e-6
+
+    with pytest.raises(ValueError, match="mode"):
+        pt.optimizer.lr.CyclicLR(0.1, 0.5, 2, mode="nope")
+    with pytest.raises(ValueError, match="positive"):
+        pt.optimizer.lr.CyclicLR(0.1, 0.5, 0)
