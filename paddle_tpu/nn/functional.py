@@ -696,3 +696,26 @@ def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
     out = out.transpose([0, 1, 3, 5, 2, 4]).reshape(
         [n, c * r * r, h // r, w // r])
     return out.transpose([0, 2, 3, 1]) if data_format == "NHWC" else out
+
+
+def affine_grid(theta, out_shape, align_corners=True):
+    """Generate a 2D flow field for grid_sample from a batch of affine
+    matrices theta [N, 2, 3] (reference: paddle.nn.functional.affine_grid).
+    Returns [N, H, W, 2] normalized (x, y) coordinates."""
+    th = _t(theta)._array
+    n, c, h, w = [int(v) for v in out_shape]
+
+    def axis_coords(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        # pixel-center convention: half-texel inset
+        return (jnp.arange(size) * 2.0 + 1.0) / size - 1.0
+
+    ys = axis_coords(h)
+    xs = axis_coords(w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1).reshape(1, h * w, 3) \
+        .astype(th.dtype)
+    out = jnp.einsum("nij,nkj->nki", th, base)
+    return Tensor._from_array(out.reshape(th.shape[0], h, w, 2))

@@ -16,7 +16,8 @@ from ..tensor import Tensor
 from ..tensor_api import _t
 from ..ops import dispatch as ops
 
-__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "box_iou"]
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "box_iou",
+           "deform_conv2d", "DeformConv2D"]
 
 
 # ------------------------------------------------------------------ box iou
@@ -258,3 +259,140 @@ def box_coder(prior_box, prior_box_var, target_box,
             out = jnp.squeeze(out, axis=1)
         return Tensor._from_array(out)
     raise ValueError(f"unknown code_type {code_type!r}")
+
+
+# ------------------------------------------------------- deformable conv
+def _bilinear_sample_nchw(x, ys, xs):
+    """Bilinear-sample x [N,C,H,W] at float coords ys/xs [N,K,Ho,Wo];
+    out-of-bounds reads contribute zero (deform-conv convention)."""
+    N, C, H, W = x.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = ys - y0
+    wx = xs - x0
+
+    def gather(yi, xi):
+        valid = ((yi >= 0) & (yi < H) & (xi >= 0) & (xi < W))
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        flat = x.reshape(N, C, H * W)
+        idx = (yc * W + xc).reshape(N, 1, -1)          # [N,1,K*Ho*Wo]
+        g = jnp.take_along_axis(flat, jnp.broadcast_to(
+            idx, (N, C, idx.shape[-1])), axis=2)
+        g = g.reshape((N, C) + yi.shape[1:])
+        return g * valid[:, None].astype(x.dtype)
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    wy = wy[:, None].astype(x.dtype)
+    wx = wx[:, None].astype(x.dtype)
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+            + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
+@ops.register("deform_conv2d", amp="allow")
+def _deform_conv2d_k(x, offset, weight, bias=None, mask=None, stride=1,
+                     padding=0, dilation=1, deformable_groups=1, groups=1):
+    """Deformable convolution v1/v2 (reference:
+    paddle.vision.ops.deform_conv2d over the deformable_conv CUDA op).
+
+    Sampling positions = regular conv grid + learned offsets (+ optional
+    v2 modulation mask); the sampled [N, Cin, kh*kw, Ho, Wo] tensor then
+    contracts with the kernel as ONE einsum — the MXU sees a dense
+    matmul, the gathers stay on the VPU."""
+    N, Cin, H, W = x.shape
+    Cout, cpg, kh, kw = weight.shape
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+    Ho = (H + 2 * p[0] - (d[0] * (kh - 1) + 1)) // s[0] + 1
+    Wo = (W + 2 * p[1] - (d[1] * (kw - 1) + 1)) // s[1] + 1
+    K = kh * kw
+
+    oy = jnp.arange(Ho) * s[0] - p[0]
+    ox = jnp.arange(Wo) * s[1] - p[1]
+    ky_g, kx_g = jnp.meshgrid(jnp.arange(kh) * d[0],
+                              jnp.arange(kw) * d[1], indexing="ij")
+    # per-tap base positions: [K, Ho, 1] / [K, 1, Wo] broadcast to
+    # [K, Ho, Wo] when combined with the offsets
+    base_y = ky_g.reshape(K, 1, 1) + oy[None, :, None]
+    base_x = kx_g.reshape(K, 1, 1) + ox[None, None, :]
+
+    off = offset.reshape(N, deformable_groups, K, 2, Ho, Wo)
+    dg_size = Cin // deformable_groups
+    outs = []
+    for g in range(deformable_groups):
+        ys = base_y[None] + off[:, g, :, 0]
+        xs = base_x[None] + off[:, g, :, 1]
+        xg = x[:, g * dg_size:(g + 1) * dg_size]
+        sampled = _bilinear_sample_nchw(xg, ys, xs)  # [N, dg, K, Ho, Wo]
+        outs.append(sampled)
+    sampled = jnp.concatenate(outs, axis=1)          # [N, Cin, K, Ho, Wo]
+    if mask is not None:                             # v2 modulation
+        m = mask.reshape(N, deformable_groups, K, Ho, Wo)
+        sampled = sampled * jnp.repeat(m, dg_size, axis=1)
+    w = weight.reshape(groups, Cout // groups, cpg, K)
+    sg = sampled.reshape(N, groups, cpg, K, Ho, Wo)
+    out = jnp.einsum("ngckyx,gock->ngoyx", sg, w)
+    out = out.reshape(N, Cout, Ho, Wo)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None):
+    args = [_t(x), _t(offset), _t(weight)]
+    kw = {"stride": stride, "padding": padding, "dilation": dilation,
+          "deformable_groups": deformable_groups, "groups": groups}
+    import paddle_tpu.ops as _po
+    if bias is not None and mask is not None:
+        return _po.call("deform_conv2d", *args, _t(bias), _t(mask), **kw)
+    if bias is not None:
+        return _po.call("deform_conv2d", *args, _t(bias), **kw)
+    if mask is not None:
+        # keyword-like dispatch: mask rides the 4th positional slot
+        return _po.call("deform_conv2d_maskonly", *args, _t(mask), **kw)
+    return _po.call("deform_conv2d", *args, **kw)
+
+
+@ops.register("deform_conv2d_maskonly", amp="allow")
+def _deform_conv2d_maskonly_k(x, offset, weight, mask, stride=1,
+                              padding=0, dilation=1, deformable_groups=1,
+                              groups=1):
+    return _deform_conv2d_k(x, offset, weight, None, mask, stride,
+                            padding, dilation, deformable_groups, groups)
+
+
+from ..nn.layer import Layer as _Layer
+
+
+class DeformConv2D(_Layer):
+    """Layer wrapper (reference: paddle.vision.ops.DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn import initializer as _I
+        k = (kernel_size, kernel_size) \
+            if isinstance(kernel_size, int) else tuple(kernel_size)
+        self._cfg = (stride, padding, dilation, deformable_groups, groups)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, k[0], k[1]],
+            attr=weight_attr,
+            default_initializer=None if getattr(
+                weight_attr, "initializer", None) else _I.KaimingUniform())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=None if bias_attr is False else bias_attr,
+            is_bias=True,
+            default_initializer=None if getattr(
+                bias_attr, "initializer", None) else _I.Constant(0.0))
+
+    def forward(self, x, offset, mask=None):
+        st, pd, dl, dg, g = self._cfg
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             st, pd, dl, dg, g, mask)

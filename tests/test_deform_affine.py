@@ -1,0 +1,72 @@
+"""deform_conv2d + affine_grid (reference: paddle.vision.ops.deform_conv2d,
+paddle.nn.functional.affine_grid)."""
+import numpy as np
+import torch
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision.ops import DeformConv2D, deform_conv2d
+
+
+def test_deform_conv_zero_offset_equals_conv():
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.randn(2, 4, 8, 8).astype(np.float32))
+    w = pt.to_tensor(rng.randn(6, 4, 3, 3).astype(np.float32))
+    zero_off = pt.zeros([2, 18, 8, 8])
+    got = deform_conv2d(x, zero_off, w, padding=1).numpy()
+    want = F.conv2d(x, w, padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_deform_conv_integer_shift():
+    rng = np.random.RandomState(1)
+    x = pt.to_tensor(rng.randn(2, 4, 8, 8).astype(np.float32))
+    w = pt.to_tensor(rng.randn(6, 4, 3, 3).astype(np.float32))
+    off = np.zeros((2, 18, 8, 8), np.float32)
+    off[:, 1::2] = 1.0  # +1 x-shift for every tap
+    got = deform_conv2d(x, pt.to_tensor(off), w, padding=1).numpy()
+    xs = np.zeros_like(x.numpy())
+    xs[:, :, :, :-1] = x.numpy()[:, :, :, 1:]
+    want = F.conv2d(pt.to_tensor(xs), w, padding=1).numpy()
+    np.testing.assert_allclose(got[:, :, 1:-1, 1:-2],
+                               want[:, :, 1:-1, 1:-2],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_deform_conv_layer_mask_and_grads():
+    pt.seed(2)
+    layer = DeformConv2D(4, 6, 3, padding=1)
+    x = pt.randn([2, 4, 8, 8])
+    x.stop_gradient = False
+    offset = pt.zeros([2, 18, 8, 8])
+    offset.stop_gradient = False
+    mask = pt.ones([2, 9, 8, 8])
+    out = layer(x, offset, mask)
+    assert out.shape == [2, 6, 8, 8]
+    out.mean().backward()
+    assert x.grad is not None and offset.grad is not None
+    assert layer.weight.grad is not None
+
+
+def test_affine_grid_matches_torch():
+    theta = np.array([[[1.0, 0.2, 0.1], [0.0, 0.9, -0.3]],
+                      [[0.8, 0.0, 0.0], [0.1, 1.1, 0.2]]], np.float32)
+    for ac in (True, False):
+        got = F.affine_grid(pt.to_tensor(theta), [2, 3, 5, 7],
+                            align_corners=ac).numpy()
+        want = torch.nn.functional.affine_grid(
+            torch.tensor(theta), (2, 3, 5, 7), align_corners=ac).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_affine_grid_identity_with_grid_sample():
+    """Identity theta + grid_sample reproduces the input."""
+    rng = np.random.RandomState(3)
+    x = pt.to_tensor(rng.randn(1, 2, 6, 6).astype(np.float32))
+    theta = np.tile(np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32),
+                    (1, 1, 1))
+    grid = F.affine_grid(pt.to_tensor(theta), [1, 2, 6, 6],
+                         align_corners=True)
+    out = F.grid_sample(x, grid, align_corners=True)
+    np.testing.assert_allclose(out.numpy(), x.numpy(), rtol=1e-4,
+                               atol=1e-5)
