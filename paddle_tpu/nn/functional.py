@@ -701,9 +701,13 @@ def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
 def affine_grid(theta, out_shape, align_corners=True):
     """Generate a 2D flow field for grid_sample from a batch of affine
     matrices theta [N, 2, 3] (reference: paddle.nn.functional.affine_grid).
-    Returns [N, H, W, 2] normalized (x, y) coordinates."""
-    th = _t(theta)._array
+    Returns [N, H, W, 2] normalized (x, y) coordinates; differentiable
+    with respect to theta (spatial-transformer use)."""
+    tht = _t(theta)
     n, c, h, w = [int(v) for v in out_shape]
+    if tht.shape[0] != n:
+        raise ValueError(
+            f"theta batch {tht.shape[0]} != out_shape batch {n}")
 
     def axis_coords(size):
         if align_corners:
@@ -711,11 +715,15 @@ def affine_grid(theta, out_shape, align_corners=True):
         # pixel-center convention: half-texel inset
         return (jnp.arange(size) * 2.0 + 1.0) / size - 1.0
 
-    ys = axis_coords(h)
-    xs = axis_coords(w)
-    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
-    ones = jnp.ones_like(gx)
-    base = jnp.stack([gx, gy, ones], axis=-1).reshape(1, h * w, 3) \
-        .astype(th.dtype)
-    out = jnp.einsum("nij,nkj->nki", th, base)
-    return Tensor._from_array(out.reshape(th.shape[0], h, w, 2))
+    def kernel(th):
+        ys = axis_coords(h)
+        xs = axis_coords(w)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1).reshape(1, h * w, 3) \
+            .astype(th.dtype)
+        out = jnp.einsum("nij,nkj->nki", th, base)
+        return out.reshape(th.shape[0], h, w, 2)
+
+    from ..autograd import engine
+    return engine.apply("affine_grid", kernel, [tht])

@@ -17,6 +17,7 @@ from .ernie import (  # noqa: F401
     ERNIE3_PRESETS,
 )
 from .generation import generate, beam_search  # noqa: F401
+from .viterbi import viterbi_decode, ViterbiDecoder  # noqa: F401
 from .transformer_mt import (  # noqa: F401
     TransformerModel, transformer_mt_loss, sinusoidal_positions,
 )

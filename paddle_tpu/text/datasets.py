@@ -51,4 +51,3 @@ Movielens = _offline("Movielens")
 UCIHousing = _offline("UCIHousing")
 WMT14 = _offline("WMT14")
 WMT16 = _offline("WMT16")
-ViterbiDecoder = _offline("ViterbiDecoder")

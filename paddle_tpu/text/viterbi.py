@@ -1,0 +1,91 @@
+"""Viterbi decoding (reference: python/paddle/text/viterbi_decode.py —
+paddle.text.viterbi_decode / ViterbiDecoder over CRF potentials).
+
+TPU-native: the forward max-product recursion is one lax.scan over time
+and the backtrace a second reversed scan — the whole decode is a single
+XLA program with static shapes ([B, T, N] potentials, [N, N] transitions,
+per-sequence lengths masked inside the scan).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.dispatch import register
+from ..tensor import Tensor
+from ..tensor_api import _t
+
+
+@register("viterbi_decode", amp="deny")
+def _viterbi_k(potentials, transitions, lengths, include_bos_eos_tag=True):
+    B, T, N = potentials.shape
+    pot = potentials.astype(jnp.float32)
+    trans = transitions.astype(jnp.float32)
+    lens = lengths.astype(jnp.int32)
+
+    if include_bos_eos_tag:
+        # reference convention: tag N-2 is BOS, N-1 is EOS; the first
+        # step starts from BOS, the last transitions into EOS
+        start = pot[:, 0] + trans[N - 2][None, :]
+    else:
+        start = pot[:, 0]
+
+    def body(carry, t):
+        alpha, back_prev = carry
+        # scores[b, i, j] = alpha[b, i] + trans[i, j] + pot[b, t, j]
+        scores = alpha[:, :, None] + trans[None] + pot[:, t][:, None, :]
+        best = jnp.argmax(scores, axis=1).astype(jnp.int32)   # [B, N]
+        new_alpha = jnp.max(scores, axis=1)
+        # frames past a sequence's length leave alpha untouched and mark
+        # the backpointer as "stay" (identity) so backtrace passes through
+        active = (t < lens)[:, None]
+        alpha2 = jnp.where(active, new_alpha, alpha)
+        back = jnp.where(active, best,
+                         jnp.broadcast_to(jnp.arange(N, dtype=jnp.int32),
+                                          (B, N)))
+        return (alpha2, back), back
+
+    (alpha, _), backs = jax.lax.scan(body, (start, jnp.zeros((B, N),
+                                                             jnp.int32)),
+                                     jnp.arange(1, T))
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, N - 1][None, :]
+    scores = jnp.max(alpha, axis=1)
+    last_tag = jnp.argmax(alpha, axis=1).astype(jnp.int32)
+
+    # backtrace: walk backs [T-1, B, N] in reverse (backs[t-1] maps the
+    # tag at time t to the best tag at t-1; identity pointers past each
+    # sequence's length let the final tag pass through).  The reversed
+    # scan's carry ends as the tag at time 0; the stacked outputs are the
+    # tags at times 1..T-1 in order.
+    def trace(tag, back_t):
+        prev = jnp.take_along_axis(back_t, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    first_tag, tags_rest = jax.lax.scan(trace, last_tag, backs,
+                                        reverse=True)
+    path = jnp.concatenate([first_tag[:, None], tags_rest.swapaxes(0, 1)],
+                           axis=1) if T > 1 else last_tag[:, None]
+    return scores, path.astype(jnp.int32)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Returns (scores [B], paths [B, T]) — positions past each sequence's
+    length repeat that row's final decoded tag."""
+    from ..ops import call as _call
+    return _call("viterbi_decode", _t(potentials), _t(transition_params),
+                 _t(lengths), include_bos_eos_tag=include_bos_eos_tag)
+
+
+class ViterbiDecoder:
+    """reference: paddle.text.ViterbiDecoder (callable holding the
+    transition matrix)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = _t(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -345,26 +345,20 @@ def _deform_conv2d_k(x, offset, weight, bias=None, mask=None, stride=1,
 
 def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
                   dilation=1, deformable_groups=1, groups=1, mask=None):
+    from ..tensor_api import zeros as _zeros
     args = [_t(x), _t(offset), _t(weight)]
     kw = {"stride": stride, "padding": padding, "dilation": dilation,
           "deformable_groups": deformable_groups, "groups": groups}
-    import paddle_tpu.ops as _po
+    if mask is not None and bias is None:
+        # dispatch passes tensors positionally: a zero bias keeps the
+        # mask in its slot without a second registry entry (one op name
+        # means AMP policy / pallas overrides cover every path)
+        bias = _zeros([weight.shape[0]], dtype="float32")
     if bias is not None and mask is not None:
-        return _po.call("deform_conv2d", *args, _t(bias), _t(mask), **kw)
+        return ops.call("deform_conv2d", *args, _t(bias), _t(mask), **kw)
     if bias is not None:
-        return _po.call("deform_conv2d", *args, _t(bias), **kw)
-    if mask is not None:
-        # keyword-like dispatch: mask rides the 4th positional slot
-        return _po.call("deform_conv2d_maskonly", *args, _t(mask), **kw)
-    return _po.call("deform_conv2d", *args, **kw)
-
-
-@ops.register("deform_conv2d_maskonly", amp="allow")
-def _deform_conv2d_maskonly_k(x, offset, weight, mask, stride=1,
-                              padding=0, dilation=1, deformable_groups=1,
-                              groups=1):
-    return _deform_conv2d_k(x, offset, weight, None, mask, stride,
-                            padding, dilation, deformable_groups, groups)
+        return ops.call("deform_conv2d", *args, _t(bias), **kw)
+    return ops.call("deform_conv2d", *args, **kw)
 
 
 from ..nn.layer import Layer as _Layer
@@ -378,19 +372,18 @@ class DeformConv2D(_Layer):
                  weight_attr=None, bias_attr=None):
         super().__init__()
         from ..nn import initializer as _I
+        from ..nn.common import _attr_init
         k = (kernel_size, kernel_size) \
             if isinstance(kernel_size, int) else tuple(kernel_size)
         self._cfg = (stride, padding, dilation, deformable_groups, groups)
         self.weight = self.create_parameter(
             [out_channels, in_channels // groups, k[0], k[1]],
             attr=weight_attr,
-            default_initializer=None if getattr(
-                weight_attr, "initializer", None) else _I.KaimingUniform())
+            default_initializer=_attr_init(weight_attr)
+            or _I.KaimingUniform())
         self.bias = None if bias_attr is False else self.create_parameter(
-            [out_channels], attr=None if bias_attr is False else bias_attr,
-            is_bias=True,
-            default_initializer=None if getattr(
-                bias_attr, "initializer", None) else _I.Constant(0.0))
+            [out_channels], attr=bias_attr, is_bias=True,
+            default_initializer=_attr_init(bias_attr) or _I.Constant(0.0))
 
     def forward(self, x, offset, mask=None):
         st, pd, dl, dg, g = self._cfg

@@ -59,6 +59,18 @@ def test_affine_grid_matches_torch():
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
+def test_affine_grid_differentiable_and_batch_check():
+    import pytest
+    theta = pt.to_tensor(np.array([[[1.0, 0, 0], [0, 1.0, 0]]],
+                                  np.float32))
+    theta.stop_gradient = False
+    grid = F.affine_grid(theta, [1, 2, 4, 4])
+    grid.sum().backward()
+    assert np.abs(theta.grad.numpy()).sum() > 0
+    with pytest.raises(ValueError, match="batch"):
+        F.affine_grid(theta, [3, 2, 4, 4])
+
+
 def test_affine_grid_identity_with_grid_sample():
     """Identity theta + grid_sample reproduces the input."""
     rng = np.random.RandomState(3)

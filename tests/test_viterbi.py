@@ -1,0 +1,58 @@
+"""paddle.text.viterbi_decode vs brute force (reference:
+python/paddle/text/viterbi_decode.py)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.text import ViterbiDecoder, viterbi_decode
+
+
+def _brute(pot_b, trans, L, N, bos_eos):
+    best, best_path = -1e30, None
+    for path in itertools.product(range(N), repeat=L):
+        s = pot_b[0][path[0]] + (trans[N - 2][path[0]] if bos_eos else 0.0)
+        for t in range(1, L):
+            s += trans[path[t - 1]][path[t]] + pot_b[t][path[t]]
+        if bos_eos:
+            s += trans[path[L - 1]][N - 1]
+        if s > best:
+            best, best_path = s, path
+    return best, best_path
+
+
+@pytest.mark.parametrize("bos_eos", [True, False])
+def test_viterbi_matches_brute_force(bos_eos):
+    rng = np.random.RandomState(0)
+    B, T, N = 3, 6, 5
+    pot = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    lens = np.array([6, 4, 1], np.int32)
+    scores, paths = viterbi_decode(pt.to_tensor(pot), pt.to_tensor(trans),
+                                   pt.to_tensor(lens),
+                                   include_bos_eos_tag=bos_eos)
+    for b in range(B):
+        L = int(lens[b])
+        want_s, want_p = _brute(pot[b], trans, L, N, bos_eos)
+        np.testing.assert_allclose(float(scores.numpy()[b]), want_s,
+                                   rtol=1e-4)
+        assert tuple(paths.numpy()[b][:L]) == want_p
+
+
+def test_viterbi_decoder_class_and_jit():
+    import jax
+    from paddle_tpu.ops.dispatch import call_raw
+    rng = np.random.RandomState(1)
+    pot = rng.randn(2, 4, 4).astype(np.float32)
+    trans = rng.randn(4, 4).astype(np.float32)
+    lens = np.array([4, 4], np.int32)
+    dec = ViterbiDecoder(pt.to_tensor(trans), include_bos_eos_tag=False)
+    s, p = dec(pt.to_tensor(pot), pt.to_tensor(lens))
+    assert p.shape == [2, 4]
+    # the whole decode compiles as one XLA program
+    s2, p2 = jax.jit(lambda a, t, l: call_raw(
+        "viterbi_decode", a, t, l, include_bos_eos_tag=False))(
+            pot, trans, lens)
+    np.testing.assert_allclose(np.asarray(s2), s.numpy(), rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(p2), p.numpy())
