@@ -46,6 +46,8 @@ from . import incubate  # noqa: F401
 from . import geometric  # noqa: F401
 from . import onnx  # noqa: F401
 from . import inference  # noqa: F401
+from . import version  # noqa: F401
+from . import sysconfig  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
 from . import callbacks  # noqa: F401
 from .hapi import Model  # noqa: F401

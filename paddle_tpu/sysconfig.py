@@ -1,0 +1,14 @@
+"""paddle.sysconfig analog (reference: python/paddle/sysconfig.py)."""
+import os
+
+
+def get_include():
+    """Directory of the native sources users can compile against (the
+    cpp_extension toolchain consumes plain extern-C, no headers needed,
+    but the path parity is kept)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "io", "native")
+
+
+def get_lib():
+    return get_include()

@@ -1,0 +1,28 @@
+"""paddle.version analog (reference: python/paddle/version.py —
+generated at build time there; static here)."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+commit = "tpu-native"
+cuda_version = "False"   # TPU-native build
+cudnn_version = "False"
+tensorrt_version = "False"
+xpu_version = "False"
+istaged = True
+with_pip = False
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print("cuda: False (TPU-native: XLA/jax backend)")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
