@@ -285,11 +285,24 @@ def main():
 
     headline = None
     if not probe_backend():
+        # value stays 0 — we never report an unmeasured number as current.
+        # last_measured points at the archived in-repo record of the most
+        # recent successful run so a claim outage at bench time doesn't
+        # erase the evidence (bench_results/r2_session2.json, measured
+        # live this round: rc=0, 16585.8 tokens/s/chip GPT-1.3B).
+        stale = None
+        try:
+            with open(os.path.join(os.path.dirname(os.path.abspath(
+                    __file__)), "bench_results", "r2_session2.json")) as f:
+                stale = json.load(f).get("headline")
+        except Exception:
+            pass
         print(json.dumps({
             "metric": "GPT train tokens/sec/chip", "value": 0.0,
             "unit": "tokens/s/chip", "vs_baseline": 0.0,
             "error": "TPU backend unavailable (probe failed fast; see "
-                     "stderr for per-attempt diagnostics)"}))
+                     "stderr for per-attempt diagnostics)",
+            "last_measured": stale}))
         return
 
     # ---- headline: GPT ladder, largest preset that fits
