@@ -292,9 +292,13 @@ def main():
         # live this round: rc=0, 16585.8 tokens/s/chip GPT-1.3B).
         stale = None
         try:
-            with open(os.path.join(os.path.dirname(os.path.abspath(
-                    __file__)), "bench_results", "r2_session2.json")) as f:
-                stale = json.load(f).get("headline")
+            import glob
+            recs = sorted(glob.glob(os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "bench_results", "*.json")), key=os.path.getmtime)
+            if recs:
+                with open(recs[-1]) as f:
+                    stale = json.load(f).get("headline")
         except Exception:
             pass
         print(json.dumps({

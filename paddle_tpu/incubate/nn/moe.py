@@ -58,6 +58,42 @@ def _activation(name):
             "swish": jax.nn.silu}[name]
 
 
+def moe_ffn_expert_choice(x, wg, w1, b1, w2, b2, *, capacity, act="gelu",
+                          z_loss_weight=0.0):
+    """Expert-choice routing (Zhou et al. 2022): each EXPERT selects its
+    top-`capacity` tokens by router score — perfectly load-balanced by
+    construction, so there is no aux loss and no token-side dropping
+    heuristics.  Same stacked-expert einsum compute path as moe_ffn.
+
+    x [N, d]; returns (y [N, d], aux==0).
+    """
+    N, d = x.shape
+    E = wg.shape[1]
+    C = capacity
+    compute_dtype = x.dtype
+
+    logits = x.astype(jnp.float32) @ wg.astype(jnp.float32)       # [N, E]
+    scores = jax.nn.softmax(logits, axis=-1)
+    # each expert picks its C best tokens
+    vals, idx = jax.lax.top_k(scores.T, C)                        # [E, C]
+    sel = jax.nn.one_hot(idx, N, dtype=compute_dtype)             # [E, C, N]
+    xin = jnp.einsum("ecn,nd->ecd", sel, x)
+    xin = _maybe_shard(xin, "ep", None, None)
+    h = jnp.einsum("ecd,edf->ecf", xin, w1.astype(compute_dtype)) \
+        + b1.astype(compute_dtype)[:, None, :]
+    h = _maybe_shard(_activation(act)(h), "ep", None, "mp")
+    out = jnp.einsum("ecf,efd->ecd", h, w2.astype(compute_dtype)) \
+        + b2.astype(compute_dtype)[:, None, :]
+    out = _maybe_shard(out, "ep", None, None)
+    # combine: scatter each expert's outputs back weighted by its score
+    y = jnp.einsum("ecn,ec,ecd->nd", sel, vals.astype(compute_dtype), out)
+    aux = jnp.zeros((), jnp.float32)   # balanced by construction
+    if z_loss_weight:                  # router z-loss still applies
+        aux = z_loss_weight * jnp.mean(
+            jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
+    return y, aux
+
+
 def moe_ffn(x, wg, w1, b1, w2, b2, *, top_k, capacity, act="gelu",
             z_loss_weight=0.0):
     """Pure-jax MoE feed-forward on flattened tokens.
@@ -141,9 +177,15 @@ class MoELayer(Layer):
 
     def __init__(self, d_model, d_hidden, num_experts, top_k=2,
                  capacity_factor=1.25, eval_capacity_factor=2.0,
-                 activation="gelu", z_loss_weight=0.0, name=None):
+                 activation="gelu", z_loss_weight=0.0, gate="top_k",
+                 name=None):
         super().__init__()
-        if top_k > num_experts:
+        if gate not in ("top_k", "gshard", "switch", "expert_choice"):
+            raise ValueError(f"unknown gate type {gate!r}")
+        if gate == "switch":
+            top_k = 1          # reference: a switch gate IS top-1 routing
+        self.gate = "top_k" if gate in ("gshard", "switch") else gate
+        if self.gate != "expert_choice" and top_k > num_experts:
             raise ValueError(f"top_k={top_k} > num_experts={num_experts}")
         self.num_experts = num_experts
         self.top_k = top_k
@@ -194,7 +236,10 @@ class MoELayer(Layer):
     def capacity(self, n_tokens):
         cf = self.capacity_factor if self.training \
             else self.eval_capacity_factor
-        c = int(math.ceil(cf * self.top_k * n_tokens / self.num_experts))
+        # expert-choice: capacity is tokens-per-expert (Zhou et al.),
+        # independent of top_k (which EC routing never uses)
+        k = 1 if self.gate == "expert_choice" else self.top_k
+        c = int(math.ceil(cf * k * n_tokens / self.num_experts))
         return max(1, min(n_tokens, c))
 
     def forward(self, x):
@@ -210,11 +255,21 @@ class MoELayer(Layer):
         for s in shape[:-1]:
             n *= s
         x2 = x.reshape([n, d])
-        out = engine.apply(
-            "moe_ffn", moe_ffn,
-            [x2, self.gate_weight, self.w1, self.b1, self.w2, self.b2],
-            {"top_k": self.top_k, "capacity": self.capacity(n),
-             "act": self.activation, "z_loss_weight": self.z_loss_weight})
+        if self.gate == "expert_choice":
+            out = engine.apply(
+                "moe_ffn_expert_choice", moe_ffn_expert_choice,
+                [x2, self.gate_weight, self.w1, self.b1, self.w2,
+                 self.b2],
+                {"capacity": self.capacity(n), "act": self.activation,
+                 "z_loss_weight": self.z_loss_weight})
+        else:
+            out = engine.apply(
+                "moe_ffn", moe_ffn,
+                [x2, self.gate_weight, self.w1, self.b1, self.w2,
+                 self.b2],
+                {"top_k": self.top_k, "capacity": self.capacity(n),
+                 "act": self.activation,
+                 "z_loss_weight": self.z_loss_weight})
         y, aux = out
         # bypass Layer.__setattr__: the live aux Tensor must NOT register
         # as a parameter (it is a per-forward activation)

@@ -70,6 +70,37 @@ def test_moe_aux_loss_balanced_lower_bound():
     assert float(m.aux_loss) >= 0.99
 
 
+def test_expert_choice_gate():
+    """Expert-choice: E=1 with full capacity equals the single expert's
+    dense FFN (softmax over 1 expert == weight 1); E>1 is balanced by
+    construction (every expert processes exactly C tokens)."""
+    pt.seed(4)
+    m = MoELayer(8, 16, num_experts=1, top_k=1, gate="expert_choice",
+                 capacity_factor=1.0)
+    x = pt.randn([6, 8])
+    y = m(x)
+    xa = x.numpy()
+    h = np.asarray(jax.nn.gelu(
+        jnp.asarray(xa @ m.w1.numpy()[0] + m.b1.numpy()[0]),
+        approximate=True))
+    ref = h @ m.w2.numpy()[0] + m.b2.numpy()[0]
+    np.testing.assert_allclose(y.numpy(), ref, rtol=2e-4, atol=2e-5)
+    assert float(m.aux_loss) == 0.0  # no aux loss needed
+
+    m2 = MoELayer(8, 16, num_experts=4, top_k=1, gate="expert_choice",
+                  capacity_factor=1.0)
+    x2 = pt.randn([16, 8])
+    x2.stop_gradient = False
+    y2 = m2(x2)
+    assert y2.shape == [16, 8]
+    y2.mean().backward()
+    assert np.abs(m2.gate_weight.grad.numpy()).sum() > 0
+    assert np.abs(x2.grad.numpy()).sum() > 0
+
+    with pytest.raises(ValueError, match="gate"):
+        MoELayer(8, 16, num_experts=2, gate="bogus")
+
+
 @pytest.fixture
 def _restore_mesh():
     prev = dict(mesh_mod._state)
