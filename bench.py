@@ -295,10 +295,13 @@ def main():
             import glob
             recs = sorted(glob.glob(os.path.join(
                 os.path.dirname(os.path.abspath(__file__)),
-                "bench_results", "*.json")), key=os.path.getmtime)
-            if recs:
-                with open(recs[-1]) as f:
+                "bench_results", "*.json")), key=os.path.getmtime,
+                reverse=True)
+            for rec in recs:   # newest record that actually has a headline
+                with open(rec) as f:
                     stale = json.load(f).get("headline")
+                if stale:
+                    break
         except Exception:
             pass
         print(json.dumps({

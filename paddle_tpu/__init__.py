@@ -48,6 +48,12 @@ from . import onnx  # noqa: F401
 from . import inference  # noqa: F401
 from . import version  # noqa: F401
 from . import sysconfig  # noqa: F401
+from . import base  # noqa: F401
+fluid = base  # legacy namespace alias (paddle.fluid)
+import sys as _sys
+# register the alias as a real module so `import paddle_tpu.fluid` and
+# `from paddle_tpu.fluid import layers` work like the reference
+_sys.modules[__name__ + ".fluid"] = base
 from .distributed.parallel import DataParallel  # noqa: F401
 from . import callbacks  # noqa: F401
 from .hapi import Model  # noqa: F401

@@ -65,10 +65,9 @@ def moe_ffn_expert_choice(x, wg, w1, b1, w2, b2, *, capacity, act="gelu",
     construction, so there is no aux loss and no token-side dropping
     heuristics.  Same stacked-expert einsum compute path as moe_ffn.
 
-    x [N, d]; returns (y [N, d], aux==0).
+    x [N, d]; returns (y [N, d], aux==0 unless z_loss_weight).
     """
-    N, d = x.shape
-    E = wg.shape[1]
+    N = x.shape[0]
     C = capacity
     compute_dtype = x.dtype
 

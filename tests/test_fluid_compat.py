@@ -1,0 +1,37 @@
+"""paddle.base / paddle.fluid legacy-namespace compatibility."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import static_graph as SG
+
+
+def test_dygraph_guard_and_to_variable():
+    with paddle.fluid.dygraph.guard():
+        x = paddle.fluid.dygraph.to_variable(np.ones((2, 3), np.float32))
+        y = paddle.fluid.layers.relu(x - 2.0)
+        assert float(y.sum()) == 0.0
+    assert paddle.fluid.CUDAPlace(0) is not None
+    assert not paddle.fluid.is_compiled_with_cuda()
+
+
+def test_fluid_static_program():
+    paddle.enable_static()
+    SG.reset()
+    try:
+        main = paddle.fluid.Program()
+        with paddle.fluid.program_guard(main):
+            d = paddle.fluid.layers.data("x", [None, 4], "float32")
+            h = paddle.fluid.layers.fc(d, 2, act="relu")
+        exe = paddle.fluid.Executor(paddle.fluid.CPUPlace())
+        (hv,) = exe.run(main, feed={"x": np.ones((3, 4), np.float32)},
+                        fetch_list=[h])
+        assert hv.shape == (3, 2) and (hv >= 0).all()
+    finally:
+        SG.reset()
+        paddle.disable_static()
+
+
+def test_lod_tensor_guidance():
+    with pytest.raises(NotImplementedError, match="sequence_mask"):
+        paddle.fluid.create_lod_tensor(None, None, None)
