@@ -217,6 +217,12 @@ class MoELayer(Layer):
         # into the training loss via paddle_tpu.incubate.nn.moe_aux_loss()
         object.__setattr__(self, "_aux_loss", None)
 
+    def restore_aux_loss(self, aux):
+        """Re-attach an aux loss computed across a trace boundary (e.g.
+        returned through recompute's jax.checkpoint) — the ONE sanctioned
+        writer of the private storage besides forward()."""
+        object.__setattr__(self, "_aux_loss", aux)
+
     @property
     def aux_loss(self):
         # NOTE: an AttributeError escaping a property falls through to

@@ -159,9 +159,15 @@ class GPTBlock(nn.Layer):
             self.mlp = GPTMLP(cfg)
         self.dropout = nn.Dropout(cfg.hidden_dropout)
 
-    def forward(self, x, cache=None):
+    def forward(self, x, cache=None, return_aux=False):
         x = x + self.dropout(self.attn(self.ln_1(x), cache=cache))
         x = x + self.dropout(self.mlp(self.ln_2(x)))
+        if return_aux:
+            # explicit output so the router aux loss crosses recompute's
+            # jax.checkpoint boundary instead of leaking via the attribute
+            aux = getattr(self.mlp, "aux_loss", None)
+            from .. import tensor_api as T
+            return x, aux if aux is not None else T.zeros([])
         return x
 
 
@@ -200,10 +206,19 @@ class GPTModel(nn.Layer):
                 position_ids = position_ids.unsqueeze(0)
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
+        from ..incubate.nn import MoELayer
         for i, block in enumerate(self.h):
             cache = caches[i] if caches is not None else None
+            routed = isinstance(block.mlp, MoELayer)
             if self.cfg.use_recompute and self.training and cache is None:
-                x = recompute(block, x)
+                if routed:
+                    # the aux loss must cross recompute's jax.checkpoint
+                    # boundary as an explicit output, then be re-attached
+                    # outside it so moe_aux_loss() reads a live tensor
+                    x, aux = recompute(block, x, return_aux=True)
+                    block.mlp.restore_aux_loss(aux)
+                else:
+                    x = recompute(block, x)
             else:
                 x = block(x, cache=cache)
         return self.ln_f(x)
