@@ -168,16 +168,18 @@ def test_moe_gpt_ep_zero_recompute_integration(_restore_mesh):
                                "sharding_degree": 2, "sharding_stage": 2}
     fleet.init(is_collective=True, strategy=strategy)
 
-    def build():
+    def build(use_recompute):
         pt.seed(7)
         cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
                         num_heads=4, max_position_embeddings=32,
                         hidden_dropout=0.0, attention_dropout=0.0,
                         tensor_parallel=False, num_experts=2, moe_top_k=1,
-                        use_recompute=True)
+                        use_recompute=use_recompute)
         return GPTForCausalLM(cfg)
 
-    m1, m2 = build(), build()
+    # reference runs WITHOUT recompute: an independent baseline, so a bug
+    # in the aux-across-checkpoint path cannot cancel out on both sides
+    m1, m2 = build(True), build(False)
     m2.set_state_dict(m1.state_dict())
     ids = pt.randint(0, 64, [4, 8])
     labels = pt.randint(0, 64, [4, 8])

