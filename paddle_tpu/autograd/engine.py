@@ -200,6 +200,7 @@ def run_backward(roots, root_grads, retain_graph=False, create_graph=False,
 
     order = _collect_nodes(roots)
     wanted_ids = {id(t) for t in (wanted or [])}
+    hooked_ids = set()  # tensors whose hooks already ran at their node
 
     for node in reversed(order):
         if node.released:
@@ -214,6 +215,12 @@ def run_backward(roots, root_grads, retain_graph=False, create_graph=False,
                 cots.append(_zero_cot(shp, dt, create_graph))
             else:
                 any_live = True
+                if t is not None and getattr(t, "_grad_hooks", None):
+                    # the output's cotangent is final here: run its hooks
+                    # (a replacement keeps flowing upstream AND accumulates)
+                    c = _apply_grad_hooks(t, c, create_graph)
+                    cot[id(t)] = c
+                    hooked_ids.add(id(t))
                 cots.append(c)
         if not any_live:
             continue
@@ -247,12 +254,18 @@ def run_backward(roots, root_grads, retain_graph=False, create_graph=False,
                 continue  # non-leaf without retain_grads(): grad not materialized
             g = cot.get(tid)
             if g is not None:
+                if tid not in hooked_ids:  # leaves: hooks run here
+                    g = _apply_grad_hooks(t, g, create_graph)
                 _accum_grad(t, g)
 
     if wanted is not None:
         out = []
         for t in wanted:
             g = cot.get(id(t))
+            if g is not None and id(t) not in hooked_ids:
+                # leaf hooks have no producing node: run them here so
+                # paddle.grad sees them too (non-leaves ran at their node)
+                g = _apply_grad_hooks(t, g, create_graph)
             if g is not None and not isinstance(g, Tensor):
                 g = Tensor._from_array(g, stop_gradient=True)
             out.append(g)
@@ -275,6 +288,27 @@ def _accum_grad(t, total):
     if t.grad is not None:
         arr = t.grad._array + arr
     t.grad = Tensor._from_array(arr, stop_gradient=True)
+
+
+def _apply_grad_hooks(t, c, create_graph):
+    """Run t's registered gradient hooks on cotangent c (reference:
+    Tensor.register_hook — a hook returning a Tensor REPLACES the gradient
+    that continues flowing/accumulating)."""
+    hooks = getattr(t, "_grad_hooks", None)
+    if not hooks:
+        return c
+    from ..tensor import Tensor
+    for hook in list(hooks.values()):
+        if create_graph:
+            g = hook(c if isinstance(c, Tensor) else Tensor._from_array(c))
+            if g is not None:
+                c = g if isinstance(g, Tensor) else Tensor._from_array(g)
+        else:
+            with no_grad():
+                g = hook(Tensor._from_array(c, stop_gradient=True))
+            if g is not None:
+                c = g._array if isinstance(g, Tensor) else g
+    return c
 
 
 def _vjp_recorded(node, cots):

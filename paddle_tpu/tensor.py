@@ -38,7 +38,8 @@ def _coerce(data, dtype=None):
 class Tensor:
     __slots__ = ("_array", "stop_gradient", "grad", "_node", "_out_index",
                  "_retain_grads", "name", "persistable", "pspec",
-                 "optimize_attr", "_sym", "_is_buffer", "__weakref__")
+                 "optimize_attr", "_sym", "_is_buffer", "_grad_hooks",
+                 "__weakref__")
 
     def __init__(self, data=None, dtype=None, place=None, stop_gradient=True,
                  name=None):
@@ -178,6 +179,26 @@ class Tensor:
 
     def retain_grads(self):
         self._retain_grads = True
+
+    def register_hook(self, hook):
+        """Register a gradient hook (reference: Tensor.register_hook):
+        called with this tensor's gradient during backward; returning a
+        Tensor replaces the gradient that keeps flowing/accumulating.
+        Returns a helper with .remove()."""
+        if self.stop_gradient:
+            raise RuntimeError(
+                "cannot register a gradient hook on a tensor with "
+                "stop_gradient=True")
+        hooks = getattr(self, "_grad_hooks", None)
+        if hooks is None:
+            hooks = _HookMap()
+            self._grad_hooks = hooks
+        # monotonic ids: never reused, so a stale helper can only remove
+        # its OWN hook
+        hid = hooks.next_id
+        hooks.next_id += 1
+        hooks[hid] = hook
+        return _TensorHookRemoveHelper(self, hid)
 
     def clear_grad(self):
         self.grad = None
@@ -548,3 +569,28 @@ def parameter(data, dtype=None, name=None):
     t = Tensor(data, dtype=dtype, stop_gradient=False, name=name)
     t.persistable = True
     return t
+
+
+class _HookMap(dict):
+    """id -> hook, with a monotonic id counter (dict subclass so the
+    engine's plain .values() iteration keeps working)."""
+    def __init__(self):
+        super().__init__()
+        self.next_id = 1
+
+
+class _TensorHookRemoveHelper:
+    """reference: TensorHookRemoveHelper — removes a registered hook."""
+
+    def __init__(self, tensor, hook_id):
+        import weakref
+        self._tensor_ref = weakref.ref(tensor)
+        self._hook_id = hook_id
+
+    def remove(self):
+        t = self._tensor_ref()
+        hooks = getattr(t, "_grad_hooks", None) if t is not None else None
+        if hooks and self._hook_id in hooks:
+            del hooks[self._hook_id]
+            return True
+        return False

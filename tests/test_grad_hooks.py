@@ -1,0 +1,80 @@
+"""Tensor.register_hook (reference: paddle.Tensor.register_hook)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _leaf(vals):
+    t = pt.to_tensor(np.asarray(vals, np.float32))
+    t.stop_gradient = False
+    return t
+
+
+def test_hook_observes_gradient():
+    x = _leaf([1.0, 2.0])
+    seen = []
+    x.register_hook(lambda g: seen.append(g.numpy().copy()))
+    (x * 3.0).sum().backward()
+    np.testing.assert_allclose(seen[0], [3.0, 3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+
+def test_hook_replaces_gradient_and_remove():
+    x = _leaf([1.0, 2.0])
+    h = x.register_hook(lambda g: g * 2.0)
+    (x * 3.0).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+    assert h.remove()
+    assert not h.remove()           # second removal reports False
+    x.clear_grad()
+    (x * 3.0).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+
+def test_intermediate_hook_affects_upstream():
+    y = _leaf([2.0])
+    z = y * 4.0
+    z.register_hook(lambda g: g * 10.0)
+    (z * 1.0).sum().backward()
+    np.testing.assert_allclose(y.grad.numpy(), [40.0])
+
+
+def test_multiple_hooks_compose_in_order():
+    x = _leaf([1.0])
+    x.register_hook(lambda g: g + 1.0)
+    x.register_hook(lambda g: g * 2.0)    # runs on the replaced grad
+    (x * 1.0).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])  # (1+1)*2
+
+
+def test_hook_rejected_on_stop_gradient():
+    with pytest.raises(RuntimeError, match="stop_gradient"):
+        pt.ones([2]).register_hook(lambda g: g)
+
+
+def test_hook_with_grad_accumulation():
+    x = _leaf([1.0])
+    x.register_hook(lambda g: g * 2.0)
+    for _ in range(2):
+        (x * 3.0).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])  # 2 passes of 6
+
+
+def test_hooks_fire_under_paddle_grad():
+    x = _leaf([1.0])
+    x.register_hook(lambda g: g * 2.0)
+    y = x * 3.0
+    (g,) = pt.grad([y.sum()], [x])
+    np.testing.assert_allclose(g.numpy(), [6.0])
+
+
+def test_stale_handle_cannot_remove_later_hook():
+    x = _leaf([1.0])
+    x.register_hook(lambda g: g + 1.0)
+    h2 = x.register_hook(lambda g: g)
+    assert h2.remove()
+    x.register_hook(lambda g: g * 5.0)   # new id, not h2's
+    assert not h2.remove()               # stale handle stays dead
+    (x * 1.0).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [10.0])  # (1+1)*5

@@ -5,7 +5,6 @@ Prints per-namespace counts of public callables/classes and a total.
 """
 from __future__ import annotations
 
-import inspect
 import os
 import sys
 
@@ -46,10 +45,7 @@ def main():
     print(f"{'namespace':34s} {'public symbols':>14s}")
     for name, mod in namespaces:
         syms = [n for n in dir(mod)
-                if not n.startswith("_")
-                and (inspect.isfunction(getattr(mod, n))
-                     or inspect.isclass(getattr(mod, n))
-                     or callable(getattr(mod, n)))]
+                if not n.startswith("_") and callable(getattr(mod, n))]
         total += len(syms)
         print(f"{name:34s} {len(syms):14d}")
     print(f"{'paddle.Tensor methods':34s} {n_tensor:14d}")
