@@ -1,0 +1,104 @@
+"""`paddle` — drop-in alias for :mod:`paddle_tpu`.
+
+The north-star for this framework (BASELINE.json) is that reference Paddle
+user code runs unmodified: ``import paddle`` must work.  This package does
+NOT re-implement anything; it makes every ``paddle.X`` name resolve to the
+*same module object* as ``paddle_tpu.X`` via a meta-path finder, so there
+is exactly one copy of every class/registry (isinstance checks, dispatch
+tables, and singletons all stay coherent between the two spellings).
+"""
+from __future__ import annotations
+
+import builtins as _builtins
+import importlib
+import importlib.abc
+import importlib.machinery
+import sys
+
+import paddle_tpu as _impl
+
+_ALIAS = "paddle"
+_REAL = "paddle_tpu"
+
+
+class _AliasLoader(importlib.abc.Loader):
+    """Loader that hands back an already-imported paddle_tpu module.
+
+    importlib overwrites ``__spec__``/``__loader__`` on the returned module
+    with the alias spec; since the module object is SHARED with its real
+    name, we restore the originals in :meth:`exec_module` so reload() and
+    spec-based introspection keep seeing the canonical identity.
+    """
+
+    def __init__(self, module):
+        self._module = module
+        self._orig_spec = getattr(module, "__spec__", None)
+        self._orig_loader = getattr(module, "__loader__", None)
+
+    def create_module(self, spec):
+        return self._module
+
+    def exec_module(self, module):  # already executed under its real name
+        module.__spec__ = self._orig_spec
+        module.__loader__ = self._orig_loader
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder):
+    """Resolve ``paddle.foo.bar`` to the ``paddle_tpu.foo.bar`` module."""
+
+    _paddle_alias_sentinel = True
+
+    def find_spec(self, fullname, path=None, target=None):
+        if not fullname.startswith(_ALIAS + "."):
+            return None
+        real_name = _REAL + fullname[len(_ALIAS):]
+        try:
+            module = importlib.import_module(real_name)
+        except ModuleNotFoundError as e:
+            # Only treat "that submodule does not exist" as a miss; an
+            # ImportError raised *inside* an existing module must surface.
+            if e.name is not None and (e.name == real_name
+                                       or real_name.startswith(e.name + ".")):
+                return None
+            raise
+        return importlib.machinery.ModuleSpec(
+            fullname, _AliasLoader(module), is_package=hasattr(module, "__path__")
+        )
+
+
+# NB: _builtins.any, not any — after the first execution the namespace
+# mirror below puts paddle's tensor ops (any/sum/min/...) into this
+# module's globals, and a reload() would resolve the shadowed names.
+if not _builtins.any(getattr(f, "_paddle_alias_sentinel", False)
+                     for f in sys.meta_path):
+    sys.meta_path.insert(0, _AliasFinder())
+
+# Mirror the full top-level surface (paddle.to_tensor, paddle.nn, ...) so
+# dir(paddle) and star-imports see everything...
+_SKIP = {
+    "__name__", "__loader__", "__spec__", "__path__", "__file__",
+    "__package__", "__builtins__", "__doc__",
+}
+globals().update(
+    {k: v for k, v in _impl.__dict__.items() if k not in _SKIP})
+
+
+# ...and keep the surfaces live: anything added to paddle_tpu after this
+# module executed still resolves as paddle.<name> (PEP 562).
+def __getattr__(name):
+    return getattr(_impl, name)
+
+
+def __dir__():
+    return _builtins.sorted(_builtins.set(globals()) | _builtins.set(dir(_impl)))
+
+
+# Pre-register every already-imported paddle_tpu submodule under the alias
+# so `sys.modules["paddle.nn"]` etc. exist even without an explicit import.
+for _name, _mod in list(sys.modules.items()):
+    if _name == _REAL or not _name.startswith(_REAL + "."):
+        continue
+    sys.modules.setdefault(_ALIAS + _name[len(_REAL):], _mod)
+
+__version__ = _impl.__version__
+del _name, _mod, _SKIP
