@@ -13,12 +13,23 @@ import math
 import os
 import queue
 import threading
+import warnings
 
 import numpy as np
 
 from ..tensor import Tensor
 from . import native
+from . import shm_loader
 from .shm_loader import ShmWorkerPool, get_worker_info, WorkerInfo  # noqa: F401
+
+
+def _forkserver_available():
+    try:
+        import multiprocessing as mp
+        import cloudpickle  # noqa: F401
+        return "forkserver" in mp.get_all_start_methods()
+    except Exception:  # pragma: no cover
+        return False
 
 
 class Dataset:
@@ -389,12 +400,12 @@ class DataLoader:
     # ------------------------------------------------- process workers
     def _use_process_workers(self):
         if not (self.use_shared_memory and native.available()
-                and hasattr(os, "fork")):
+                and _forkserver_available()):
             return False
         if self._iterable:
             # no sample probe: iterating could consume a single-use stream.
-            # Workers convert to numpy and fail loudly on device-backed
-            # samples under a TPU backend (shm_loader._to_numpy_tree).
+            # Workers run on a cpu-forced jax platform and ship numpy back
+            # (shm_loader._to_numpy_tree).
             return True
         if self._probe_host is None:
             # device-backed samples must not cross fork(): probe ONE sample,
@@ -404,14 +415,6 @@ class DataLoader:
             except Exception:
                 self._probe_host = False
         return self._probe_host
-
-    @staticmethod
-    def _device_unsafe():
-        import jax
-        try:
-            return jax.default_backend() != "cpu"
-        except Exception:  # pragma: no cover
-            return True
 
     def _process_iter(self):
         dataset = self.dataset
@@ -439,11 +442,24 @@ class DataLoader:
 
         worker_collate = _numpy_collate \
             if self.collate_fn is default_collate_fn else self.collate_fn
+        try:
+            spec_blob = shm_loader.serialize_spec(
+                self.num_workers, dataset, batch_iter_fn, worker_collate,
+                self.worker_init_fn)
+        except Exception as e:
+            # work spec not serializable even by value (live handles,
+            # sockets, ...): degrade to in-process threaded workers
+            warnings.warn(
+                f"DataLoader: dataset/collate not serializable for process "
+                f"workers ({e}); falling back to threads", RuntimeWarning)
+            yield from self._threaded_iter()
+            return
         pool = ShmWorkerPool(
             self.num_workers, dataset, batch_iter_fn, worker_collate,
             worker_init_fn=self.worker_init_fn,
-            **({"ring_bytes": self.ring_bytes} if self.ring_bytes else {}),
-            timeout_s=self.timeout, device_unsafe=self._device_unsafe())
+            **({"ring_bytes": self.ring_bytes} if self.ring_bytes
+               else {}),
+            timeout_s=self.timeout, spec_blob=spec_blob)
         for batch in pool:
             yield _rewrap_numpy(batch)
 

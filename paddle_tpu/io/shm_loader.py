@@ -2,20 +2,27 @@
 
 Reference parity: python/paddle/io/dataloader/dataloader_iter.py
 (_DataLoaderIterMultiProcess) + its C++ shared-memory transport.  Design:
-each worker is a forked process owning one SPSC ring (ring.c) mapped into
-an anonymous shared mmap; worker w produces batches w, w+W, w+2W, ... so
-the parent reads rings round-robin and global batch order is preserved
-without any cross-process coordination.  Payloads are pickle protocol-5
-blobs of numpy pytrees — workers never touch jax or the TPU client; the
-parent converts to Tensors after receipt.
+each worker is a **forkserver** process (never os.fork() from the parent —
+forking a multithreaded, JAX-initialized process is a documented deadlock
+risk) owning one SPSC ring (ring.c) mapped from a file in /dev/shm; worker
+w produces batches w, w+W, w+2W, ... so the parent reads rings round-robin
+and global batch order is preserved without any cross-process
+coordination.  The work spec (dataset, batch iterator, collate) crosses to
+the child as a cloudpickle blob, so locally-defined datasets/lambdas work
+like they did under fork.  Payloads back are pickle protocol-5 blobs of
+numpy pytrees; children force their own jax platform to cpu so they can
+never race the parent for the TPU claim.
 """
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import mmap
 import os
 import pickle
 import signal
+import tempfile
+import threading
 import traceback
 
 import numpy as np
@@ -24,6 +31,10 @@ from . import native
 
 _DEFAULT_RING_BYTES = 64 << 20
 _WORKER_INFO = None
+
+
+def _shm_dir():
+    return "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
 
 
 class WorkerInfo:
@@ -39,15 +50,13 @@ def get_worker_info():
     return _WORKER_INFO
 
 
-class _Ring:
-    """Parent-side handle to one worker's shared ring."""
+class _RingBase:
+    """Shared mmap + native SPSC ring ops over it."""
 
-    def __init__(self, size=_DEFAULT_RING_BYTES):
-        self.mm = mmap.mmap(-1, size)  # anonymous shared, fork-inherited
+    def _map(self, fd, size):
+        self.mm = mmap.mmap(fd, size)
         self._buf = ctypes.c_char.from_buffer(self.mm)
         self.addr = ctypes.addressof(self._buf)
-        if native.LIB.ring_init(self.addr, size) != 0:
-            raise RuntimeError("ring_init failed")
 
     def write(self, payload: bytes, timeout_ms=-1):
         r = native.LIB.ring_write(self.addr, payload, len(payload),
@@ -81,31 +90,57 @@ class _Ring:
             pass
 
 
-def _to_numpy_tree(obj, device_unsafe):
-    """Convert a batch pytree to pure numpy/python for pickling.
+class _Ring(_RingBase):
+    """Parent-side ring: creates the backing file (in /dev/shm) + inits."""
 
-    `device_unsafe` is the parent's pre-fork verdict (non-CPU jax backend):
-    converting a device-backed Tensor would use the inherited TPU client in
-    the forked child — fail loudly instead of deadlocking the tunnel.
-    """
+    def __init__(self, size=_DEFAULT_RING_BYTES):
+        fd, self.path = tempfile.mkstemp(prefix="pt_ring_", dir=_shm_dir())
+        try:
+            os.ftruncate(fd, size)
+            self._map(fd, size)
+        finally:
+            os.close(fd)  # the mmap holds its own reference
+        self.size = size
+        if native.LIB.ring_init(self.addr, size) != 0:
+            raise RuntimeError("ring_init failed")
+
+    def release(self):
+        super().release()
+        try:
+            os.unlink(self.path)
+        except OSError:  # pragma: no cover
+            pass
+
+
+class _ChildRing(_RingBase):
+    """Worker-side ring: attaches to the parent's backing file."""
+
+    def __init__(self, path, size):
+        fd = os.open(path, os.O_RDWR)
+        try:
+            self._map(fd, size)
+        finally:
+            os.close(fd)
+
+
+def _to_numpy_tree(obj):
+    """Convert a batch pytree to pure numpy/python for pickling.  Workers
+    run on a cpu-forced jax platform, so device-backed Tensors created by
+    the dataset/collate in the child convert safely; the parent re-wraps
+    numpy into device Tensors after receipt."""
     from ..tensor import Tensor
     if isinstance(obj, Tensor):
-        if device_unsafe:
-            raise RuntimeError(
-                "DataLoader worker produced a device-backed Tensor; with a "
-                "TPU backend, datasets/collate_fn used with num_workers>0 "
-                "must return numpy (or pass use_shared_memory=False)")
         return np.asarray(obj._array)
     if isinstance(obj, (list, tuple)):
-        return type(obj)(_to_numpy_tree(o, device_unsafe) for o in obj)
+        return type(obj)(_to_numpy_tree(o) for o in obj)
     if isinstance(obj, dict):
-        return {k: _to_numpy_tree(v, device_unsafe) for k, v in obj.items()}
+        return {k: _to_numpy_tree(v) for k, v in obj.items()}
     return obj
 
 
 def _worker_main(ring, worker_id, num_workers, dataset, batch_iter_fn,
-                 collate_fn, init_fn, device_unsafe):
-    """Runs in the forked child: produce this worker's batch slice.
+                 collate_fn, init_fn):
+    """Runs in the worker child: produce this worker's batch slice.
 
     Returns True on clean completion.  On error, ships an E-message and
     closes the ring; if even that fails, the ring is left OPEN and False
@@ -120,7 +155,7 @@ def _worker_main(ring, worker_id, num_workers, dataset, batch_iter_fn,
         if init_fn is not None:
             init_fn(worker_id)
         for samples in batch_iter_fn(worker_id, num_workers):
-            batch = _to_numpy_tree(collate_fn(samples), device_unsafe)
+            batch = _to_numpy_tree(collate_fn(samples))
             ring.write(b"B" + pickle.dumps(batch, protocol=5))
         ring.close_producer()
         return True
@@ -138,45 +173,143 @@ def _worker_main(ring, worker_id, num_workers, dataset, batch_iter_fn,
         return False  # ring left open → parent sees a dead worker
 
 
+def serialize_spec(num_workers, dataset, batch_iter_fn, collate_fn,
+                   worker_init_fn):
+    """cloudpickle the work spec (by value: __main__/locally-defined
+    datasets and closures cross to the worker like they did under fork).
+    Raises whatever cloudpickle raises — callers that want a fallback
+    probe this BEFORE constructing the pool."""
+    import cloudpickle
+    return cloudpickle.dumps(
+        (num_workers, dataset, batch_iter_fn, collate_fn, worker_init_fn))
+
+
+def _worker_entry(ring_path, ring_size, worker_id, spec_blob):
+    """Forkserver child entrypoint (module-level: importable by name).
+
+    The child NEVER touches the TPU: force its jax platform to cpu before
+    any user code runs, so a dataset that builds Tensors initializes a
+    private CPU backend instead of racing the parent for the axon claim.
+    """
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # pragma: no cover
+        pass
+    import cloudpickle
+    code = 1
+    try:
+        num_workers, dataset, batch_iter_fn, collate_fn, init_fn = \
+            cloudpickle.loads(spec_blob)
+        ring = _ChildRing(ring_path, ring_size)
+        # shrink the tmpfs-leak window on hard parent death: once both
+        # sides are mapped the name is no longer needed (parent release
+        # tolerates ENOENT)
+        try:
+            os.unlink(ring_path)
+        except OSError:
+            pass
+        ok = _worker_main(ring, worker_id, num_workers, dataset,
+                          batch_iter_fn, collate_fn, init_fn)
+        code = 0 if ok else 1
+    finally:
+        os._exit(code)  # skip atexit/GC teardown races
+
+
+def _mp_context():
+    import multiprocessing as mp
+    ctx = mp.get_context("forkserver")
+    # Amortize the package import (~4s) across all workers: the forkserver
+    # server imports once, every worker forks from it instantly.  No-op
+    # once the server is already running.
+    try:
+        ctx.set_forkserver_preload(["paddle_tpu.io.shm_loader"])
+    except Exception:  # pragma: no cover
+        pass
+    return ctx
+
+
+_PATCH_LOCK = threading.RLock()
+_PATCH_DEPTH = 0
+_PATCH_ORIG = None
+
+
+@contextlib.contextmanager
+def _no_main_reimport():
+    """Strip the __main__-module fixup from mp's child preparation data.
+
+    Workers never need the parent's __main__: the work spec crosses as a
+    cloudpickle blob, which serializes __main__-defined datasets/functions
+    BY VALUE.  Without this, spawn/forkserver children try to re-run the
+    parent script (runpy), which (a) breaks for <stdin>/REPL parents and
+    (b) re-executes unguarded training scripts — both unacceptable for a
+    data-worker process.
+
+    The patch is refcounted under a lock so concurrent/nested pools can't
+    capture each other's wrapper and leave the stripped version installed
+    permanently (which would break the user's own mp children).  Unrelated
+    Processes started by other threads during the window do lose their
+    __main__ re-import — the lock holds the window to the worker starts.
+    """
+    global _PATCH_DEPTH, _PATCH_ORIG
+    from multiprocessing import spawn as mp_spawn
+    with _PATCH_LOCK:
+        if _PATCH_DEPTH == 0:
+            _PATCH_ORIG = mp_spawn.get_preparation_data
+
+            def stripped(name, _orig=_PATCH_ORIG):
+                d = _orig(name)
+                d.pop("init_main_from_name", None)
+                d.pop("init_main_from_path", None)
+                return d
+
+            mp_spawn.get_preparation_data = stripped
+        _PATCH_DEPTH += 1
+        try:
+            yield
+        finally:
+            _PATCH_DEPTH -= 1
+            if _PATCH_DEPTH == 0:
+                mp_spawn.get_preparation_data = _PATCH_ORIG
+                _PATCH_ORIG = None
+
+
 class ShmWorkerPool:
-    """Fork N workers, read their rings round-robin in batch order."""
+    """Start N forkserver workers, read their rings round-robin in batch
+    order."""
 
     _POLL_MS = 100  # bounded ring polls so worker death is noticed
 
     def __init__(self, num_workers, dataset, batch_iter_fn, collate_fn,
                  worker_init_fn=None, ring_bytes=_DEFAULT_RING_BYTES,
-                 timeout_s=0, device_unsafe=False):
-        self._rings = [_Ring(ring_bytes) for _ in range(num_workers)]
+                 timeout_s=0, spec_blob=None):
+        if spec_blob is None:
+            spec_blob = serialize_spec(num_workers, dataset, batch_iter_fn,
+                                       collate_fn, worker_init_fn)
+        ctx = _mp_context()
         self._timeout_ms = int(timeout_s * 1000) if timeout_s else -1
-        self._pids = []
-        self._exited = set()
-        for w in range(num_workers):
-            pid = os.fork()
-            if pid == 0:  # child
-                code = 1
-                try:
-                    ok = _worker_main(self._rings[w], w, num_workers,
-                                      dataset, batch_iter_fn, collate_fn,
-                                      worker_init_fn, device_unsafe)
-                    code = 0 if ok else 1
-                finally:
-                    os._exit(code)  # skip parent atexit/GC (jax client!)
-            self._pids.append(pid)
+        self._rings = []
+        self._procs = []
+        try:
+            for _ in range(num_workers):
+                self._rings.append(_Ring(ring_bytes))
+            with _no_main_reimport():
+                for w in range(num_workers):
+                    p = ctx.Process(
+                        target=_worker_entry,
+                        args=(self._rings[w].path, self._rings[w].size, w,
+                              spec_blob),
+                        daemon=True)
+                    p.start()
+                    self._procs.append(p)
+        except BaseException:
+            self.shutdown()
+            raise
 
     def _worker_dead(self, ring):
         """True if this ring's worker exited without closing the ring
         (SIGKILL/OOM/segfault) — data will never arrive."""
-        pid = self._pids[self._rings.index(ring)]
-        if pid in self._exited:
-            return True
-        try:
-            got, _ = os.waitpid(pid, os.WNOHANG)
-        except ChildProcessError:
-            got = pid
-        if got == pid:
-            self._exited.add(pid)
-            return True
-        return False
+        return not self._procs[self._rings.index(ring)].is_alive()
 
     def __iter__(self):
         live = list(self._rings)
@@ -213,17 +346,12 @@ class ShmWorkerPool:
             self.shutdown()
 
     def shutdown(self):
-        for pid in self._pids:
-            try:
-                os.kill(pid, signal.SIGTERM)
-            except ProcessLookupError:
-                pass
-        for pid in self._pids:
-            try:
-                os.waitpid(pid, 0)
-            except ChildProcessError:
-                pass
-        self._pids = []
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self._procs:
+            p.join()
+        self._procs = []
         for r in self._rings:
             r.release()
         self._rings = []
