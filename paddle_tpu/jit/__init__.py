@@ -22,14 +22,33 @@ from ..nn.layer import Layer
 from . import functional_bridge as FB
 from .train_step import train_step, TrainStep  # noqa: F401
 from .save_load import InputSpec, TranslatedLayer  # noqa: F401
+from . import dy2static  # noqa: F401
+from .dy2static import convert_to_static  # noqa: F401
+
+_TO_STATIC_ENABLED = True
+
+
+def enable_to_static(flag: bool):
+    """paddle.jit.enable_to_static parity: with False, to_static-wrapped
+    callables run eagerly (useful for debugging converted control flow)."""
+    global _TO_STATIC_ENABLED
+    _TO_STATIC_ENABLED = bool(flag)
 
 
 class StaticFunction:
-    def __init__(self, layer, fn=None):
+    def __init__(self, layer, fn=None, while_max_iters=None):
         self._layer = layer
         self._fn = fn  # unbound forward substitute, if not layer.__call__
         self._pure_cache = {}   # (training, static_key) -> jitted pure fn
         self._out_treedef = {}
+        self._while_max_iters = while_max_iters
+        # dy2static: rewrite data-dependent control flow in forward onto
+        # lax.cond/while_loop/scan (reference: python/paddle/jit/dy2static)
+        self._conv_forward = None
+        if fn is None:
+            conv, changed = convert_to_static(type(layer).forward)
+            if changed:
+                self._conv_forward = conv
 
     @property
     def layer(self):
@@ -53,11 +72,23 @@ class StaticFunction:
                 in_treedef, [Tensor._from_array(a) for a in in_arrays])
             prev = layer.training
             _set_training(layer, training)
+            patched = False
+            if self._conv_forward is not None and \
+                    "forward" not in layer.__dict__:
+                # converted forward as an instance attribute: __call__
+                # still runs the hook machinery around it
+                import types as _types
+                layer.forward = _types.MethodType(self._conv_forward, layer)
+                patched = True
             try:
-                out, new_buffers = FB.call_functional(
-                    layer, p_arrays, b_arrays, args,
-                    kwargs_arrays=static_kwargs, rng_key=rng, fn=self._fn)
+                with dy2static.while_bound(self._while_max_iters):
+                    out, new_buffers = FB.call_functional(
+                        layer, p_arrays, b_arrays, args,
+                        kwargs_arrays=static_kwargs, rng_key=rng,
+                        fn=self._fn)
             finally:
+                if patched:
+                    del layer.__dict__["forward"]
                 _set_training(layer, prev)
             flat_out, out_treedef = jax.tree_util.tree_flatten(out)
             self._out_treedef[key] = (out_treedef, len(flat_out))
@@ -69,6 +100,9 @@ class StaticFunction:
 
     def __call__(self, *args, **kwargs):
         layer = self._layer
+        if not _TO_STATIC_ENABLED:
+            return layer(*args, **kwargs) if self._fn is None else \
+                self._fn(*args, **kwargs)
         params = list(dict(layer.named_parameters()).values())
         buffer_d = dict(layer.named_buffers())
         buffers = list(buffer_d.values())
@@ -107,38 +141,62 @@ def _set_training(layer, mode):
         l.training = mode
 
 
-def to_static(function=None, input_spec=None, full_graph=True, **kwargs):
-    """Decorator/wrapper compiling a Layer or function to one XLA program."""
+def to_static(function=None, input_spec=None, full_graph=True,
+              while_max_iters=None, **kwargs):
+    """Decorator/wrapper compiling a Layer or function to one XLA program.
+
+    `while_max_iters`: bound converted tensor-dependent `while` loops to a
+    fixed iteration count (lowered to a masked lax.scan), which makes them
+    reverse-differentiable — unbounded while_loops are forward-only."""
     def wrap(target):
         if isinstance(target, Layer):
-            return StaticFunction(target)
+            return StaticFunction(target, while_max_iters=while_max_iters)
         if callable(target):
             # bare function of Tensors: jit directly through the tape
-            return _static_fn(target)
+            return _static_fn(target, while_max_iters=while_max_iters)
         raise TypeError(type(target))
     if function is not None:
         return wrap(function)
     return wrap
 
 
-def _static_fn(fn):
+def _is_static_leaf(a):
+    """Python values that gate control flow specialize the trace (one
+    compiled program per distinct value, like reference dy2static's
+    per-python-arg-combo programs) instead of being tensorized."""
+    return a is None or isinstance(a, (bool, str, bytes))
+
+
+def _static_fn(fn, while_max_iters=None):
     cache = {}
+    fn, _ = convert_to_static(fn)
 
     @functools.wraps(fn)
     def wrapper(*args):
+        if not _TO_STATIC_ENABLED:
+            return fn(*args)
         flat_in, in_treedef = jax.tree_util.tree_flatten(
             args, is_leaf=lambda x: isinstance(x, Tensor))
+        statics = tuple((i, a) for i, a in enumerate(flat_in)
+                        if _is_static_leaf(a))
         in_tensors = [a if isinstance(a, Tensor) else
-                      Tensor._from_array(jnp.asarray(a)) for a in flat_in]
-        state = cache.get(in_treedef)
+                      Tensor._from_array(jnp.asarray(a))
+                      for a in flat_in if not _is_static_leaf(a)]
+        key = (in_treedef, statics)
+        state = cache.get(key)
         if state is None:
             out_info = {}
 
             def pure(*arrays):
+                flat = list(arrays)
+                for i, v in statics:
+                    flat.insert(i, v)
                 targs = jax.tree_util.tree_unflatten(
                     in_treedef,
-                    [Tensor._from_array(a) for a in arrays])
-                with engine.no_grad():
+                    [Tensor._from_array(a) if not _is_static_leaf(a)
+                     else a for a in flat])
+                with engine.no_grad(), dy2static.while_bound(
+                        while_max_iters):
                     out = fn(*targs)
                 flat_out, td = jax.tree_util.tree_flatten(FB._unwrap(out))
                 out_info["td"] = td
@@ -146,7 +204,7 @@ def _static_fn(fn):
                 return tuple(flat_out)
 
             state = (jax.jit(pure), out_info)
-            cache[in_treedef] = state
+            cache[key] = state
         pure, out_info = state
         result = engine.apply("to_static_fn", pure, in_tensors)
         result = result if isinstance(result, tuple) else (result,)
@@ -156,6 +214,9 @@ def _static_fn(fn):
 
 
 def not_to_static(fn):
+    """Opt a function out of dy2static control-flow conversion
+    (reference: paddle.jit.not_to_static)."""
+    fn._paddle_not_to_static = True
     return fn
 
 

@@ -1,0 +1,811 @@
+"""dy2static: AST conversion of data-dependent Python control flow.
+
+Reference: python/paddle/jit/dy2static — the reference rewrites if/while/for
+over tensor values into cond_op/while_op graph nodes.  Here the targets are
+the XLA-native structured-control-flow primitives: `lax.cond`,
+`lax.while_loop`, `lax.scan`.
+
+Two halves:
+  * `convert_to_static(fn)` — parses the function source, rewrites every
+    eligible `if` / `while` / `for` statement (and `and`/`or`/`not` inside
+    their tests) into calls to the runtime converters below, and compiles
+    the new AST back to a function.
+  * runtime converters (`convert_if` / `convert_while` / `convert_for` /
+    `convert_range` / …) — decide AT TRACE TIME which path to take: a
+    Python-valued predicate executes natively (zero semantic change, loops
+    unroll exactly like plain jax tracing), a traced-tensor predicate maps
+    onto the lax primitive.
+
+The transform is top-down and deliberately conservative.  A block
+containing `break`/`continue` (bound to that block), nested `def`/`class`,
+`global`/`nonlocal`, `del`, `yield`, or stores to attributes/subscripts is
+left untouched: native Python semantics are preserved there, and a
+tensor-dependent predicate in such a block surfaces jax's concretization
+error.  `return` inside an `if` converts only in the every-path-returns
+form (if/elif/else chains where each tail returns); early returns under a
+tensor predicate are a documented limitation, mirroring the reference's
+(python/paddle/jit/dy2static/transformers/return_transformer.py).
+"""
+from __future__ import annotations
+
+import ast
+import contextlib
+import functools
+import inspect
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor import Tensor
+
+
+# ===================================================================
+# runtime
+# ===================================================================
+class _Undefined:
+    """Placeholder for a name not yet bound when a converted block runs.
+    Any meaningful use raises, restoring (approximate) NameError
+    semantics; the generated cleanup `if x is _jst.UNDEF: del x` restores
+    the exact ones after the block."""
+
+    _MSG = "variable is not defined on this code path (dy2static)"
+
+    def __repr__(self):
+        return "<dy2static UNDEF>"
+
+    def _raise(self, *a, **k):
+        raise NameError(self._MSG)
+
+    __bool__ = __iter__ = __len__ = __call__ = __index__ = _raise
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _raise
+    __getitem__ = __getattr__ = _raise
+
+
+UNDEF = _Undefined()
+
+
+class RangeSpec:
+    """`range()` whose bounds are traced tensors (convert_range)."""
+
+    def __init__(self, start, stop, step):
+        self.start, self.stop, self.step = start, stop, step
+
+
+def _arr(x):
+    return x._array if isinstance(x, Tensor) else x
+
+
+def _is_traced(x):
+    return isinstance(_arr(x), jax.core.Tracer)
+
+
+def _python_pred(p):
+    """bool(p) when p is decidable in Python; None when p is traced."""
+    if _is_traced(p):
+        return None
+    return bool(_arr(p))
+
+
+def _flatten_vals(vals):
+    """Split a tuple of block-output values into dynamic array leaves and
+    a rebuild recipe.  Tensors / jax arrays / numeric Python scalars are
+    dynamic and cross the lax primitive as arrays; everything else
+    (UNDEF, None, strings, ...) is static and must match across
+    branches/iterations.  Returns (leaves, comparable_key, rebuild)."""
+    leaves, rebuild, keyparts = [], [], []
+    flat, treedef = jax.tree_util.tree_flatten(
+        list(vals), is_leaf=lambda x: isinstance(x, (Tensor, _Undefined)))
+    for leaf in flat:
+        if isinstance(leaf, Tensor) or isinstance(leaf, jax.Array) or \
+                type(leaf) in (bool, int, float, complex):
+            leaves.append(jnp.asarray(_arr(leaf)))
+            rebuild.append("dyn")
+            keyparts.append("dyn")
+        else:
+            rebuild.append(("static", leaf))
+            try:
+                keyparts.append(("static", hash(leaf), leaf))
+            except TypeError:
+                keyparts.append(("static", "unhashable", id(leaf)))
+    return leaves, (treedef, tuple(keyparts)), rebuild
+
+
+def _rebuild_vals(arrays, treedef, rebuild):
+    out, it = [], iter(arrays)
+    for r in rebuild:
+        if r == "dyn":
+            out.append(Tensor._from_array(next(it)))
+        else:
+            out.append(r[1])
+    return tuple(jax.tree_util.tree_unflatten(treedef, out))
+
+
+def _mismatch(names, what):
+    return ValueError(
+        f"dy2static: the {what} produce different structures for "
+        f"output variable(s) {tuple(names)}; both paths of a "
+        f"tensor-dependent control-flow block must bind the same "
+        f"variables with matching shapes/dtypes (assign them before "
+        f"the block)")
+
+
+def _run_cond(pred, true_fn, false_fn, init, names):
+    """Shared lax.cond driver: fns take init values, return value tuples."""
+    meta = {}
+    in_leaves, (in_treedef, _), in_rebuild = _flatten_vals(init)
+
+    def wrap(fn, tag):
+        def g(arrays):
+            out = fn(*_rebuild_vals(arrays, in_treedef, in_rebuild))
+            leaves, key, rebuild = _flatten_vals(out)
+            meta[tag] = (key, rebuild)
+            return tuple(leaves)
+        return g
+
+    try:
+        res = lax.cond(jnp.asarray(_arr(pred)).astype(bool).reshape(()),
+                       wrap(true_fn, "t"), wrap(false_fn, "f"),
+                       tuple(in_leaves))
+    except TypeError as e:
+        raise _mismatch(names, "branches of this `if`") from e
+    if meta["t"][0] != meta["f"][0]:
+        raise _mismatch(names, "branches of this `if`")
+    (treedef_out, _), rebuild_out = meta["t"]
+    return _rebuild_vals(list(res), treedef_out, rebuild_out)
+
+
+def convert_if(pred, true_fn, false_fn, init, names):
+    pv = _python_pred(pred)
+    if pv is not None:
+        return (true_fn if pv else false_fn)(*init)
+    return _run_cond(pred, true_fn, false_fn, init, names)
+
+
+def convert_if_return(pred, true_fn, false_fn, init):
+    """Both-branches-return form: branch fns return the function's return
+    value; the converted statement is `return convert_if_return(...)`."""
+    pv = _python_pred(pred)
+    if pv is not None:
+        return (true_fn if pv else false_fn)(*init)
+    out = _run_cond(pred, lambda *a: (true_fn(*a),),
+                    lambda *a: (false_fn(*a),), init,
+                    ("<return value>",))
+    return out[0]
+
+
+_WHILE_MAX_ITERS = None  # set via while_bound() during a to_static trace
+
+
+@contextlib.contextmanager
+def while_bound(n):
+    """Bound traced `while` loops to n iterations, lowering them to a
+    masked lax.scan — which IS reverse-differentiable, unlike
+    lax.while_loop.  Threaded from to_static(..., while_max_iters=n)."""
+    global _WHILE_MAX_ITERS
+    old = _WHILE_MAX_ITERS
+    _WHILE_MAX_ITERS = n
+    try:
+        yield
+    finally:
+        _WHILE_MAX_ITERS = old
+
+
+def _seed_undef(init, run_body, names):
+    """Replace UNDEF init slots with zero-trees of the structure one body
+    iteration produces (discovered with jax.eval_shape, so nothing
+    executes on device).  Loop temps are written before read, so the seed
+    value is never observed while the loop runs; after ZERO iterations a
+    seeded temp reads as zeros instead of raising NameError — the one
+    documented divergence (reference dy2static requires pre-assignment
+    outright)."""
+    if not any(v is UNDEF for v in init):
+        return init
+    rec = {}
+
+    def probe():
+        out = run_body(init)
+        per = [_flatten_vals((o,)) for o in out]
+        rec["per"] = [(key, rb) for _, key, rb in per]
+        return tuple(l for lv, _, _ in per for l in lv)
+
+    try:
+        shapes = list(jax.eval_shape(probe))
+    except NameError as e:
+        raise NameError(
+            f"dy2static: a loop body reads a variable before assigning "
+            f"it and it is undefined before the loop (vars "
+            f"{tuple(names)}): {e}") from None
+    out = list(init)
+    si = 0
+    for i, (key, rb) in enumerate(rec["per"]):
+        ndyn = sum(1 for r in rb if r == "dyn")
+        slot_shapes = shapes[si:si + ndyn]
+        si += ndyn
+        if out[i] is UNDEF:
+            leaves = [jnp.zeros(s.shape, s.dtype) for s in slot_shapes]
+            out[i] = _rebuild_vals(leaves, key[0], rb)[0]
+    return tuple(out)
+
+
+def convert_while(cond_fn, body_fn, init, names):
+    pv = _python_pred(cond_fn(*init))
+    if pv is not None:
+        vals = init
+        while pv:
+            vals = body_fn(*vals)
+            pv = _python_pred(cond_fn(*vals))
+            if pv is None:
+                raise ValueError(
+                    f"dy2static: this `while` condition became "
+                    f"tensor-dependent mid-loop (vars {tuple(names)}); "
+                    f"make the first condition evaluation tensor-"
+                    f"dependent too")
+        return vals
+
+    init = _seed_undef(init, lambda i: body_fn(*i), names)
+    in_leaves, (in_treedef, _), in_rebuild = _flatten_vals(init)
+
+    def cond(arrays):
+        p = cond_fn(*_rebuild_vals(arrays, in_treedef, in_rebuild))
+        return jnp.asarray(_arr(p)).astype(bool).reshape(())
+
+    def body(arrays):
+        out = body_fn(*_rebuild_vals(arrays, in_treedef, in_rebuild))
+        leaves, _, _ = _flatten_vals(out)
+        if len(leaves) != len(arrays):
+            raise _mismatch(names, "iterations of this `while`")
+        # same-dtype strongification only (never a cross-dtype cast):
+        # weak-typed scalars must not make while_loop avals mismatch
+        return tuple(l.astype(l.dtype) for l in leaves)
+
+    in_leaves = _stabilize_carry(body, in_leaves, names, "`while`")
+    try:
+        if _WHILE_MAX_ITERS is not None:
+            res = _bounded_while(cond, body, tuple(in_leaves),
+                                 _WHILE_MAX_ITERS)
+        else:
+            res = lax.while_loop(cond, body, tuple(in_leaves))
+    except TypeError as e:
+        raise _mismatch(names, "iterations of this `while`") from e
+    return _rebuild_vals(list(res), in_treedef, in_rebuild)
+
+
+def _stabilize_carry(body, in_leaves, names, what):
+    """Fix the loop-carry dtypes by promoting the SEED to what one body
+    iteration produces (int seed + float body → float carry), never the
+    reverse — silently truncating the body's floats back to an int seed
+    dtype would change values (or spin a while_loop forever).  A carry
+    that still drifts after one promotion is genuinely unstable."""
+    out = jax.eval_shape(body, tuple(in_leaves))
+    if len(out) != len(in_leaves):
+        raise _mismatch(names, f"iterations of this {what}")
+    promoted = []
+    for l, o in zip(in_leaves, out):
+        a = jnp.asarray(l)
+        weak = getattr(getattr(a, "aval", a), "weak_type", False)
+        if a.dtype != o.dtype or weak:
+            a = a.astype(o.dtype)
+        promoted.append(a)
+    promoted = tuple(promoted)
+    out2 = jax.eval_shape(body, promoted)
+    for o, l, n in zip(out2, promoted, list(names) + ["?"] * len(promoted)):
+        if o.dtype != l.dtype or o.shape != l.shape:
+            raise ValueError(
+                f"dy2static: loop variable '{n}' changes "
+                f"{'dtype' if o.dtype != l.dtype else 'shape'} across "
+                f"iterations of this {what} "
+                f"({l.dtype}{list(l.shape)} → {o.dtype}{list(o.shape)}); "
+                f"tensor loops need loop-invariant shapes/dtypes")
+    return promoted
+
+
+def _bounded_while(cond, body, init, n):
+    """while as a length-n masked scan (differentiable)."""
+
+    def f(carry, _):
+        arrays, done = carry
+        active = jnp.logical_and(jnp.logical_not(done), cond(arrays))
+        new = body(arrays)
+        out = tuple(jnp.where(active, nw, a) for a, nw in
+                    zip(arrays, new))
+        return (out, jnp.logical_or(done, jnp.logical_not(active))), None
+
+    (res, _), _ = lax.scan(f, (init, jnp.asarray(False)), None, length=n)
+    return res
+
+
+def convert_range(*args):
+    if any(_is_traced(a) for a in args):
+        vals = [jnp.asarray(_arr(a)) for a in args]
+        if len(vals) == 1:
+            return RangeSpec(jnp.asarray(0), vals[0], jnp.asarray(1))
+        if len(vals) == 2:
+            return RangeSpec(vals[0], vals[1], jnp.asarray(1))
+        return RangeSpec(*vals)
+    return range(*(int(_arr(a)) if isinstance(_arr(a), jax.Array)
+                   else _arr(a) for a in args))
+
+
+def convert_for(iterable, body_fn, init, names):
+    if isinstance(iterable, RangeSpec):
+        return _for_range(iterable, body_fn, init, names)
+    if isinstance(iterable, Tensor) and _is_traced(iterable):
+        return _for_scan(iterable, body_fn, init, names)
+    vals = init
+    if isinstance(iterable, Tensor):
+        iterable = [iterable[k] for k in range(iterable.shape[0])]
+    for item in iterable:
+        vals = body_fn(item, *vals)
+    return vals
+
+
+def _for_range(spec, body_fn, init, names):
+    start, stop, step = (jnp.asarray(v) for v in
+                         (spec.start, spec.stop, spec.step))
+
+    def cond_fn(i, *vals):
+        ia = jnp.asarray(_arr(i))
+        return Tensor._from_array(
+            jnp.where(step > 0, ia < stop, ia > stop))
+
+    def body(i, *vals):
+        out = body_fn(Tensor._from_array(jnp.asarray(_arr(i))), *vals)
+        return (Tensor._from_array(jnp.asarray(_arr(i)) + step),) + \
+            tuple(out)
+
+    res = convert_while(cond_fn, body,
+                        (Tensor._from_array(start),) + tuple(init),
+                        ("<loop index>",) + tuple(names))
+    return res[1:]
+
+
+def _for_scan(xs, body_fn, init, names):
+    arr = xs._array
+    item0 = jax.ShapeDtypeStruct(arr.shape[1:], arr.dtype)
+    init = _seed_undef(
+        init, lambda i: body_fn(
+            Tensor._from_array(jnp.zeros(item0.shape, item0.dtype)), *i),
+        names)
+    in_leaves, (in_treedef, _), in_rebuild = _flatten_vals(init)
+
+    def f(carry, x):
+        out = body_fn(Tensor._from_array(x),
+                      *_rebuild_vals(carry, in_treedef, in_rebuild))
+        leaves, _, _ = _flatten_vals(out)
+        if len(leaves) != len(carry):
+            raise _mismatch(names, "iterations of this `for`")
+        return tuple(l.astype(l.dtype) for l in leaves), None
+
+    in_leaves = _stabilize_carry(
+        lambda arrs: f(arrs, jnp.zeros(item0.shape, item0.dtype))[0],
+        in_leaves, names, "`for`")
+    try:
+        carry, _ = lax.scan(f, tuple(in_leaves), arr)
+    except TypeError as e:
+        raise _mismatch(names, "iterations of this `for`") from e
+    return _rebuild_vals(list(carry), in_treedef, in_rebuild)
+
+
+def convert_ifexp(pred, true_fn, false_fn):
+    pv = _python_pred(pred)
+    if pv is not None:
+        return true_fn() if pv else false_fn()
+    t, f = true_fn(), false_fn()
+    return Tensor._from_array(
+        jnp.where(jnp.asarray(_arr(pred)).astype(bool), _arr(t), _arr(f)))
+
+
+def convert_bool_op(op, *operand_fns):
+    """`and`/`or` inside a converted test: short-circuit + value semantics
+    for Python operands, logical_and/or once a traced tensor appears."""
+    acc = operand_fns[0]()
+    for fn in operand_fns[1:]:
+        if not _is_traced(acc):
+            pv = bool(_arr(acc))
+            if (op == "and" and not pv) or (op == "or" and pv):
+                return acc                      # short-circuit
+            acc = fn()                          # `a and b` returns b
+        else:
+            v = fn()
+            a = jnp.asarray(_arr(acc)).astype(bool)
+            b = jnp.asarray(_arr(v)).astype(bool)
+            acc = Tensor._from_array(
+                jnp.logical_and(a, b) if op == "and"
+                else jnp.logical_or(a, b))
+    return acc
+
+
+def convert_not(v):
+    if _is_traced(v):
+        return Tensor._from_array(
+            jnp.logical_not(jnp.asarray(_arr(v)).astype(bool)))
+    return not v
+
+
+# ===================================================================
+# AST analysis
+# ===================================================================
+_BLOCKERS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+             ast.Import, ast.ImportFrom, ast.Global, ast.Nonlocal,
+             ast.Delete, ast.Yield, ast.YieldFrom, ast.Await,
+             ast.AsyncFor, ast.AsyncWith)
+
+
+class _BlockInfo(ast.NodeVisitor):
+    """Scan one block body: assigned names + transformability."""
+
+    def __init__(self):
+        self.assigned = set()
+        self.blocked = False        # defs/imports/del/global/...
+        self.has_return = False
+        self.has_loopjump = False   # break/continue bound to THIS block
+        self._loop_depth = 0
+
+    def scan(self, body):
+        for stmt in body:
+            self.visit(stmt)
+        return self
+
+    # --- blockers
+    def generic_visit(self, node):
+        if isinstance(node, _BLOCKERS):
+            self.blocked = True
+            return
+        super().generic_visit(node)
+
+    def visit_Return(self, node):
+        self.has_return = True
+        self.generic_visit(node)
+
+    def visit_Break(self, node):
+        if self._loop_depth == 0:
+            self.has_loopjump = True
+
+    def visit_Continue(self, node):
+        if self._loop_depth == 0:
+            self.has_loopjump = True
+
+    # break/continue inside a nested loop belong to that loop
+    def visit_While(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    def visit_For(self, node):
+        self._target(node.target)
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    # --- assignments
+    def _target(self, t):
+        if isinstance(t, ast.Name):
+            self.assigned.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e)
+        elif isinstance(t, ast.Starred):
+            self._target(t.value)
+        else:
+            # store into attribute/subscript: a side effect lax.cond
+            # can't capture functionally — refuse the whole block
+            self.blocked = True
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._target(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_NamedExpr(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_With(self, node):
+        for item in node.items:
+            if item.optional_vars is not None:
+                self._target(item.optional_vars)
+        self.generic_visit(node)
+
+
+def _all_paths_return(body):
+    """True when every terminal path of `body` ends in `return <expr>`."""
+    if not body:
+        return False
+    last = body[-1]
+    if isinstance(last, ast.Return):
+        return last.value is not None
+    if isinstance(last, ast.If):
+        return _all_paths_return(last.body) and \
+            _all_paths_return(last.orelse)
+    return False
+
+
+# ===================================================================
+# codegen helpers
+# ===================================================================
+def _no_args():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None,
+                         defaults=[])
+
+
+def _params(names):
+    a = _no_args()
+    a.args = [ast.arg(arg=n, annotation=None) for n in names]
+    return a
+
+
+def _call(name, args):
+    return ast.Call(
+        func=ast.Attribute(value=ast.Name("_jst", ast.Load()),
+                           attr=name, ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _fndef(name, params, body):
+    fd = ast.FunctionDef(name=name, args=params, body=body,
+                         decorator_list=[], returns=None)
+    fd.type_params = []
+    return fd
+
+
+def _load_tuple(names):
+    return ast.Tuple([ast.Name(n, ast.Load()) for n in names], ast.Load())
+
+
+def _preamble(outputs, uid):
+    """try: _d2s_pre_x_N = x / except NameError: ... = UNDEF, per name."""
+    stmts, pre_names = [], []
+    for o in outputs:
+        pre = f"_d2s_pre_{o}_{uid}"
+        pre_names.append(pre)
+        stmts.append(ast.Try(
+            body=[ast.Assign([ast.Name(pre, ast.Store())],
+                             ast.Name(o, ast.Load()))],
+            handlers=[ast.ExceptHandler(
+                type=ast.Tuple([ast.Name("NameError", ast.Load()),
+                                ast.Name("UnboundLocalError", ast.Load())],
+                               ast.Load()),
+                name=None,
+                body=[ast.Assign(
+                    [ast.Name(pre, ast.Store())],
+                    ast.Attribute(ast.Name("_jst", ast.Load()), "UNDEF",
+                                  ast.Load()))])],
+            orelse=[], finalbody=[]))
+    return stmts, pre_names
+
+
+def _assign_outputs(outputs, call):
+    if not outputs:
+        return ast.Expr(call)
+    return ast.Assign(
+        [ast.Tuple([ast.Name(o, ast.Store()) for o in outputs],
+                   ast.Store())], call)
+
+
+def _cleanup(outputs):
+    """if x is _jst.UNDEF: del x — restores NameError semantics."""
+    return [ast.If(
+        test=ast.Compare(
+            left=ast.Name(o, ast.Load()), ops=[ast.Is()],
+            comparators=[ast.Attribute(ast.Name("_jst", ast.Load()),
+                                       "UNDEF", ast.Load())]),
+        body=[ast.Delete([ast.Name(o, ast.Del())])],
+        orelse=[]) for o in outputs]
+
+
+# ===================================================================
+# the transformer (top-down: decide on pristine AST, then recurse into
+# the generated branch/body functions)
+# ===================================================================
+class _Dy2StTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.changed = False
+        self._n = 0
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    def visit_FunctionDef(self, node):
+        # a fn using global/nonlocal writes can't have its assignments
+        # moved into nested branch functions — skip the whole fn
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Global, ast.Nonlocal)):
+                return node
+        self.generic_visit(node)
+        return node
+
+    # ---------------------------------------------------------- if
+    def visit_If(self, node):
+        t_info = _BlockInfo().scan(node.body)
+        f_info = _BlockInfo().scan(node.orelse)
+        if t_info.blocked or f_info.blocked or \
+                t_info.has_loopjump or f_info.has_loopjump:
+            self.generic_visit(node)
+            return node
+
+        all_ret = _all_paths_return(node.body) and \
+            _all_paths_return(node.orelse)
+        if (t_info.has_return or f_info.has_return) and not all_ret:
+            self.generic_visit(node)
+            return node
+
+        self.changed = True
+        uid = self._uid()
+        outputs = sorted(t_info.assigned | f_info.assigned)
+        test = _TestTransformer().visit(node.test)
+        stmts, pre_names = _preamble(outputs, uid)
+        tn, fn_ = f"_d2s_true_{uid}", f"_d2s_false_{uid}"
+
+        if all_ret:
+            t_fd = _fndef(tn, _params(outputs), list(node.body))
+            f_fd = _fndef(fn_, _params(outputs), list(node.orelse))
+            tail = [ast.Return(_call("convert_if_return", [
+                test, ast.Name(tn, ast.Load()), ast.Name(fn_, ast.Load()),
+                _load_tuple(pre_names)]))]
+        else:
+            ret = ast.Return(_load_tuple(outputs))
+            t_fd = _fndef(tn, _params(outputs), list(node.body) + [ret])
+            f_fd = _fndef(fn_, _params(outputs),
+                          (list(node.orelse) or [ast.Pass()]) +
+                          [ast.Return(_load_tuple(outputs))])
+            tail = [_assign_outputs(outputs, _call("convert_if", [
+                test, ast.Name(tn, ast.Load()), ast.Name(fn_, ast.Load()),
+                _load_tuple(pre_names), ast.Constant(tuple(outputs))]))]
+            tail += _cleanup(outputs)
+        # recurse into the branch bodies for nested control flow
+        self.generic_visit(t_fd)
+        self.generic_visit(f_fd)
+        return stmts + [t_fd, f_fd] + tail
+
+    # ---------------------------------------------------------- while
+    def visit_While(self, node):
+        info = _BlockInfo().scan(node.body)
+        if info.blocked or info.has_loopjump or info.has_return or \
+                node.orelse:
+            self.generic_visit(node)
+            return node
+        self.changed = True
+        uid = self._uid()
+        outputs = sorted(info.assigned)
+        test = _TestTransformer().visit(node.test)
+        stmts, pre_names = _preamble(outputs, uid)
+        cn, bn = f"_d2s_cond_{uid}", f"_d2s_body_{uid}"
+        c_fd = _fndef(cn, _params(outputs), [ast.Return(test)])
+        b_fd = _fndef(bn, _params(outputs),
+                      list(node.body) + [ast.Return(_load_tuple(outputs))])
+        self.generic_visit(b_fd)
+        tail = [_assign_outputs(outputs, _call("convert_while", [
+            ast.Name(cn, ast.Load()), ast.Name(bn, ast.Load()),
+            _load_tuple(pre_names), ast.Constant(tuple(outputs))]))]
+        return stmts + [c_fd, b_fd] + tail + _cleanup(outputs)
+
+    # ---------------------------------------------------------- for
+    def visit_For(self, node):
+        info = _BlockInfo().scan(node.body)
+        tgt = _BlockInfo()
+        tgt._target(node.target)
+        if info.blocked or tgt.blocked or info.has_loopjump or \
+                info.has_return or node.orelse:
+            self.generic_visit(node)
+            return node
+        self.changed = True
+        uid = self._uid()
+        outputs = sorted(info.assigned | tgt.assigned)
+
+        it = node.iter
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                and it.func.id == "range" and not it.keywords and \
+                not any(isinstance(a, ast.Starred) for a in it.args):
+            it = _call("convert_range", it.args)
+
+        stmts, pre_names = _preamble(outputs, uid)
+        bn, item = f"_d2s_forbody_{uid}", f"_d2s_item_{uid}"
+        params = _params(outputs)
+        params.args.insert(0, ast.arg(arg=item, annotation=None))
+        unpack = ast.Assign([node.target], ast.Name(item, ast.Load()))
+        b_fd = _fndef(bn, params,
+                      [unpack] + list(node.body) +
+                      [ast.Return(_load_tuple(outputs))])
+        self.generic_visit(b_fd)
+        tail = [_assign_outputs(outputs, _call("convert_for", [
+            it, ast.Name(bn, ast.Load()), _load_tuple(pre_names),
+            ast.Constant(tuple(outputs))]))]
+        return stmts + [b_fd] + tail + _cleanup(outputs)
+
+
+    # ------------------------------------------------------- ternary
+    def visit_IfExp(self, node):
+        self.generic_visit(node)
+        self.changed = True
+        return _call("convert_ifexp", [
+            node.test,
+            ast.Lambda(args=_no_args(), body=node.body),
+            ast.Lambda(args=_no_args(), body=node.orelse)])
+
+
+class _TestTransformer(ast.NodeTransformer):
+    """Inside an if/while test: and/or/not → tensor-aware converters."""
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        op = "and" if isinstance(node.op, ast.And) else "or"
+        return _call("convert_bool_op", [ast.Constant(op)] + [
+            ast.Lambda(args=_no_args(), body=v) for v in node.values])
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _call("convert_not", [node.operand])
+        return node
+
+
+# ===================================================================
+# entry
+# ===================================================================
+def convert_to_static(fn):
+    """Return (converted_fn, changed).  On any reason the source can't be
+    transformed (no source, lambda, decorated wrapper chain, opted out via
+    jit.not_to_static, no control flow) the original function comes back
+    with changed=False.
+
+    Known limitation (shared with reference dy2static, which also
+    recompiles sources): the converted function resolves module globals
+    through a snapshot taken at conversion time, so rebinding a bare
+    module-level name afterwards (e.g. mock.patch of a helper) is not
+    visible to the converted code; attribute access through a module
+    object stays live."""
+    raw = fn.__func__ if inspect.ismethod(fn) else fn
+    if getattr(raw, "_paddle_not_to_static", False):
+        return fn, False
+    if getattr(raw, "__wrapped__", None) is not None:
+        # decorated: recompiling the inner function would silently drop
+        # the wrapper's behavior — leave the chain alone
+        return fn, False
+    if not inspect.isfunction(raw):
+        return fn, False
+    try:
+        src = textwrap.dedent(inspect.getsource(raw))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn, False
+    fdef = tree.body[0]
+    if not isinstance(fdef, ast.FunctionDef):
+        return fn, False
+    fdef.decorator_list = []
+    tr = _Dy2StTransformer()
+    tree = tr.visit(tree)
+    if not tr.changed:
+        return fn, False
+    ast.fix_missing_locations(tree)
+    code = compile(tree, f"<dy2static:{getattr(raw, '__qualname__', '?')}>",
+                   "exec")
+    glb = dict(raw.__globals__)
+    glb["_jst"] = sys.modules[__name__]
+    # snapshot closure cells as globals (the re-compiled source has no
+    # enclosing scope; late rebinding of closures is not visible)
+    if raw.__closure__:
+        for name, cell in zip(raw.__code__.co_freevars, raw.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:  # pragma: no cover - empty cell
+                pass
+    exec(code, glb)
+    new_fn = glb[fdef.name]
+    new_fn.__defaults__ = raw.__defaults__
+    new_fn.__kwdefaults__ = raw.__kwdefaults__
+    functools.update_wrapper(new_fn, raw)
+    return new_fn, True
