@@ -395,8 +395,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     the op registry override; XLA reference path otherwise."""
     q, k, v = _t(query), _t(key), _t(value)
     if attn_mask is not None:
-        out = ops.call("sdpa", q, k, v, _t(attn_mask),
-                       is_causal=is_causal, scale=scale)
+        m = _t(attn_mask)
+        # a TRAINED additive mask (ALiBi-style bias) must take the XLA
+        # path: the flash kernel does not produce mask gradients
+        out = ops.call("sdpa", q, k, v, m,
+                       is_causal=is_causal, scale=scale,
+                       _mask_needs_grad=not m.stop_gradient)
     else:
         from ..autograd import engine
         out = engine.apply(

@@ -348,10 +348,19 @@ def embedding_k(w, ids, padding_idx=None):
 
 # --------------------------------------------------------------- attention
 @register("sdpa", amp="allow")
-def sdpa_k(q, k, v, mask=None, is_causal=False, scale=None):
+def sdpa_k(q, k, v, mask=None, is_causal=False, scale=None,
+           _mask_needs_grad=False):
     """Scaled dot-product attention, (B, L, H, D) layout like the reference's
-    nn.functional.scaled_dot_product_attention. Softmax in fp32."""
+    nn.functional.scaled_dot_product_attention. Softmax in fp32.
+    GQA: fewer kv heads are repeat_interleave-broadcast up to q heads (the
+    pallas override handles grouping natively, without the repeat).
+    `_mask_needs_grad` is consumed by the pallas override (forces this XLA
+    path, which differentiates through `scores + mask`); ignored here."""
     d = q.shape[-1]
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     scores = jnp.einsum("blhd,bmhd->bhlm", q, k) * scale
     scores = scores.astype(jnp.float32)

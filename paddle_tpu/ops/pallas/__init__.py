@@ -40,12 +40,14 @@ def _mode():
 _xla_sdpa = get("sdpa").fn
 
 
-def sdpa_with_flash(q, k, v, mask=None, is_causal=False, scale=None):
+def sdpa_with_flash(q, k, v, mask=None, is_causal=False, scale=None,
+                    _mask_needs_grad=False):
     mode = _mode()
-    if mode is not None and _fa.supports(q.shape, k.shape, mask, q.dtype,
-                                         v_shape=v.shape,
-                                         is_causal=is_causal):
-        return _fa.flash_attention(q, k, v, is_causal=is_causal, scale=scale,
+    if mode is not None and not _mask_needs_grad and \
+            _fa.supports(q.shape, k.shape, mask, q.dtype,
+                         v_shape=v.shape, is_causal=is_causal):
+        return _fa.flash_attention(q, k, v, mask=mask, is_causal=is_causal,
+                                   scale=scale,
                                    interpret=(mode == "interpret"))
     return _xla_sdpa(q, k, v, mask=mask, is_causal=is_causal, scale=scale)
 

@@ -129,10 +129,9 @@ class LlamaAttention(nn.Layer):
             k = T.concat([cache["k"], k], axis=1)
             v = T.concat([cache["v"], v], axis=1)
             cache["k"], cache["v"] = k, v
-        rep = cfg.num_heads // cfg.num_kv_heads
-        if rep > 1:
-            k = k.repeat_interleave(rep, axis=2)
-            v = v.repeat_interleave(rep, axis=2)
+        # GQA heads stay UNREPEATED: the sdpa dispatch handles grouping —
+        # natively inside the pallas flash kernel (kv-head index map), or
+        # via repeat_interleave in the XLA fallback (sdpa_k)
         if prealloc:
             out = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=mask, dropout_p=0.0,

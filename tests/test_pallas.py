@@ -98,6 +98,156 @@ def test_flash_causal_cross_length():
 def test_supports_gate():
     s = (2, 128, 4, 64)
     assert fa.supports(s, s, None, jnp.float32)
-    assert not fa.supports(s, s, object(), jnp.float32)   # explicit mask
-    assert not fa.supports((2, 100, 4, 64), s, None, jnp.float32)  # ragged
+    assert not fa.supports(s, s, object(), jnp.float32)   # weird mask obj
+    # ragged (round 3): handled by internal padding now
+    assert fa.supports((2, 100, 4, 64), s, None, jnp.float32)
+    assert not fa.supports(s, s, None, jnp.int32)
+
+
+# ----------------------------------------------------- round-3 extensions
+def _ref_gqa(q, k, v, mask=None, is_causal=False):
+    return sdpa_k(q, k, v, mask=mask, is_causal=is_causal)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gqa_matches_repeat(causal):
+    # kv heads grouped inside the kernel == repeat_interleave + dense
+    rng = np.random.default_rng(6)
+    B, L, H, Hkv, D = 2, 128, 8, 2, 64
+    q = jnp.asarray(rng.standard_normal((B, L, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, L, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, L, Hkv, D)), jnp.float32)
+    out = fa.flash_attention(q, k, v, is_causal=causal, interpret=True)
+    kr = jnp.repeat(k, H // Hkv, axis=2)
+    vr = jnp.repeat(v, H // Hkv, axis=2)
+    ref = sdpa_k(q, kr, vr, is_causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_gqa_grads():
+    rng = np.random.default_rng(7)
+    B, L, H, Hkv, D = 1, 128, 4, 2, 32
+    q = jnp.asarray(rng.standard_normal((B, L, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, L, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, L, Hkv, D)), jnp.float32)
+
+    def loss_flash(q, k, v):
+        o = fa.flash_attention(q, k, v, is_causal=True, interpret=True)
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        kr = jnp.repeat(k, H // Hkv, axis=2)
+        vr = jnp.repeat(v, H // Hkv, axis=2)
+        return jnp.sum(jnp.sin(sdpa_k(q, kr, vr, is_causal=True)))
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("mask_kind", ["bool_padding", "additive_full",
+                                       "bool_full_bh"])
+def test_flash_masks(mask_kind):
+    rng = np.random.default_rng(8)
+    B, L, H, D = 2, 128, 2, 64
+    q, k, v = _rand_qkv(rng, B, L, H, D)
+    if mask_kind == "bool_padding":
+        # (B, 1, 1, Lk) key-padding mask, rows broadcast
+        lens = np.array([100, 77])
+        m = (np.arange(L)[None, :] < lens[:, None])
+        mask = jnp.asarray(m)[:, None, None, :]
+    elif mask_kind == "additive_full":
+        mask = jnp.asarray(
+            np.where(rng.random((B, 1, L, L)) < 0.8, 0.0, -1e9), jnp.float32)
+    else:
+        mask = jnp.asarray(rng.random((B, H, L, L)) < 0.9)
+    assert fa.supports(q.shape, k.shape, mask, q.dtype)
+    out = fa.flash_attention(q, k, v, mask=mask, interpret=True)
+    ref = sdpa_k(q, k, v, mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_mask_grads():
+    rng = np.random.default_rng(9)
+    B, L, H, D = 1, 128, 2, 32
+    q, k, v = _rand_qkv(rng, B, L, H, D)
+    lens = np.array([90])
+    mask = jnp.asarray((np.arange(L)[None, :] < lens[:, None]))[:, None,
+                                                                None, :]
+
+    def loss_flash(q, k, v):
+        o = fa.flash_attention(q, k, v, mask=mask, interpret=True)
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(sdpa_k(q, k, v, mask=mask)))
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("shape", [(1, 100, 2, 64), (2, 257, 2, 32),
+                                   (1, 7, 2, 64)])
+def test_flash_ragged_lens(shape):
+    # non-block-divisible seq lens: padded internally, cols masked
+    rng = np.random.default_rng(10)
+    q, k, v = _rand_qkv(rng, *shape)
+    for causal in (False, True):
+        out = fa.flash_attention(q, k, v, is_causal=causal, interpret=True)
+        ref = sdpa_k(q, k, v, is_causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_flash_ragged_grads():
+    rng = np.random.default_rng(11)
+    q, k, v = _rand_qkv(rng, 1, 100, 2, 32)
+
+    def loss_flash(q, k, v):
+        o = fa.flash_attention(q, k, v, is_causal=True, interpret=True)
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(sdpa_k(q, k, v, is_causal=True)))
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_flash_decode_shape():
+    # Lq=1 single-token decode against a KV cache with a padding mask
+    rng = np.random.default_rng(12)
+    B, Lk, H, D = 2, 128, 4, 64
+    q = jnp.asarray(rng.standard_normal((B, 1, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, Lk, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, Lk, H, D)), jnp.float32)
+    lens = np.array([64, 100])
+    mask = jnp.asarray((np.arange(Lk)[None, :] < lens[:, None]))[:, None,
+                                                                 None, :]
+    out = fa.flash_attention(q, k, v, mask=mask, interpret=True)
+    ref = sdpa_k(q, k, v, mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_supports_gate_round3():
+    s = (2, 128, 4, 64)
+    skv = (2, 128, 2, 64)   # GQA now supported
+    assert fa.supports(s, skv, None, jnp.float32)
+    assert not fa.supports(s, (2, 128, 3, 64), None, jnp.float32)  # 4%3
+    assert fa.supports((2, 100, 4, 64), s[:1] + (100,) + s[2:], None,
+                       jnp.float32)  # ragged now supported
+    mask = jnp.zeros((2, 1, 128, 128), jnp.float32)
+    assert fa.supports(s, s, mask, jnp.float32)
+    assert not fa.supports(s, s, object(), jnp.float32)  # weird mask obj
     assert not fa.supports(s, s, None, jnp.int32)

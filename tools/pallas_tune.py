@@ -4,13 +4,19 @@ Run on the real TPU (axon tunnel).  For each GPT-shaped config, checks
 numerics vs the XLA sdpa reference and times fwd and fwd+bwd for the
 pallas kernel at several (block_q, block_k) choices vs plain XLA.
 
+With --write, the best (bq, bk) per (head_dim, seq) is recorded into
+paddle_tpu/ops/pallas/tuned_blocks.json — the table flash_attention
+loads by default ({gen: {head_dim: {seq_bucket: [bq, bk]}}}).
+
 Timing uses host reads (jax.block_until_ready does not sync on the
 tunnel — see .claude/skills/verify/SKILL.md).
 
-Usage: python tools/pallas_tune.py [--quick]
+Usage: python tools/pallas_tune.py [--quick] [--write]
 """
 import argparse
 import itertools
+import json
+import os
 import sys
 import time
 
@@ -23,6 +29,8 @@ from paddle_tpu.ops.pallas import flash_attention as FA  # noqa: E402
 from paddle_tpu.ops import dispatch  # noqa: E402
 
 _xla_sdpa = dispatch.get("sdpa").fn
+_TABLE_PATH = os.path.join(os.path.dirname(__file__), "..", "paddle_tpu",
+                           "ops", "pallas", "tuned_blocks.json")
 
 
 def _sync(x):
@@ -43,6 +51,9 @@ def time_fn(fn, *args, iters=20, warmup=3):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--write", action="store_true",
+                    help="update paddle_tpu/ops/pallas/tuned_blocks.json "
+                         "with the best (bq, bk) per (head_dim, seq)")
     args = ap.parse_args()
 
     print("devices:", jax.devices(), file=sys.stderr)
@@ -50,8 +61,10 @@ def main():
     if not args.quick:
         shapes.append((2, 4096, 16, 128))
     blocks = [(256, 256), (512, 512)] if args.quick else \
-        [(128, 128), (256, 256), (512, 512), (512, 256), (256, 512)]
+        [(128, 128), (256, 256), (512, 512), (512, 256), (256, 512),
+         (1024, 512), (512, 1024)]
 
+    best = {}   # (D, L) -> (t_fwd_bwd, (bq, bk))
     for (B, L, H, D), causal in itertools.product(shapes, (True, False)):
         key = jax.random.PRNGKey(0)
         kq, kk, kv, kg = jax.random.split(key, 4)
@@ -80,9 +93,10 @@ def main():
               f"fwd+bwd {t_x_b*1e3:.2f}ms", flush=True)
 
         for bq, bk in blocks:
+            if bq > L or bk > L:
+                continue
             if not FA.supports(q.shape, k.shape, None, q.dtype,
-                               v_shape=v.shape, is_causal=causal,
-                               block_q=bq, block_k=bk):
+                               v_shape=v.shape, is_causal=causal):
                 print(f"  pallas bq{bq} bk{bk}: unsupported shape")
                 continue
 
@@ -106,9 +120,30 @@ def main():
                       f"({flops/t_p_f/1e12:.1f} TF/s, {t_x_f/t_p_f:.2f}x) "
                       f"fwd+bwd {t_p_b*1e3:.2f}ms ({t_x_b/t_p_b:.2f}x) "
                       f"maxerr {err:.4f}", flush=True)
+                # tune on the causal train-shape step time (the bench path)
+                if causal and err < 0.1:
+                    cur = best.get((D, L))
+                    if cur is None or t_p_b < cur[0]:
+                        best[(D, L)] = (t_p_b, (bq, bk))
             except Exception as e:  # Mosaic compile errors surface here
                 msg = str(e).splitlines()[0][:160]
                 print(f"  pallas bq{bq} bk{bk}: FAILED {msg}", flush=True)
+
+    if args.write and best:
+        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+        path = os.path.abspath(_TABLE_PATH)
+        try:
+            with open(path) as f:
+                table = json.load(f)
+        except (OSError, ValueError):
+            table = {}
+        for (D, L), (_, bqbk) in best.items():
+            table.setdefault(gen, {}).setdefault(str(D), {})[str(L)] = \
+                list(bqbk)
+        with open(path, "w") as f:
+            json.dump(table, f, indent=1, sort_keys=True)
+        print(f"\nwrote {path}: "
+              f"{ {k: v[1] for k, v in best.items()} }", flush=True)
 
 
 if __name__ == "__main__":
