@@ -108,14 +108,8 @@ class DistributedTrainStep:
             raise ValueError(
                 "pp_degree > 1 requires the model to implement "
                 "pipeline_decompose() (blocks/pre/post stage plan)")
-        if self.use_pp:
-            from ..incubate.nn.moe import MoELayer
-            if any(isinstance(l, MoELayer)
-                   for l in model.sublayers(include_self=True)):
-                raise NotImplementedError(
-                    "pp_degree > 1 with MoE blocks is not supported: the "
-                    "router aux losses cannot escape the pipelined scan — "
-                    "use dp x ep x mp for expert models")
+        # pp x MoE works since round 3: router aux losses ride the
+        # pipelined scan as an explicit per-step output (pipeline.py)
         pc = getattr(strategy, "pipeline_configs", None) or {}
         self.n_microbatches = int(
             pc.get("accumulate_steps") if int(pc.get(
@@ -149,11 +143,9 @@ class DistributedTrainStep:
                 f"{len(blocks)} pipeline blocks do not divide into "
                 f"pp_degree={self.pp} x virtual_pp_degree={self.vpp} "
                 "virtual stages")
-        for b in blocks:
-            if list(b.named_buffers()):
-                raise ValueError(
-                    "pipelined blocks with buffers (BatchNorm-style running "
-                    "stats) are not supported; keep them outside the blocks")
+        # blocks may hold buffers (read-only inside the pipelined scan:
+        # rope tables, eval-mode BN stats); mutation raises at trace time
+        # in _make_run_pipeline's block_apply
         block_ids = {id(p) for b in blocks for _, p in b.named_parameters()}
         outer_named = [(n, p) for n, p in self.model.named_parameters()
                        if id(p) not in block_ids]
@@ -432,19 +424,59 @@ class DistributedTrainStep:
     # ----------------------------------------------------------------- step
     def _make_run_pipeline(self, stacked, rng):
         """Closure the shim calls in place of model.__call__: pre → GPipe
-        shard_map over "pp" (dp/mp left to GSPMD inside) → post."""
+        shard_map over "pp" (dp/mp left to GSPMD inside) → post.
+
+        Block buffers (rope tables, eval-BN stats) are stacked from the
+        traced per-model buffer args into [pp, lps, ...] leaves and ride
+        the same stacked tree as the params (prefix "buf::"), read-only;
+        MoE router aux losses come back as the pipeline's aux output and
+        are restored onto the model's first MoE layer so loss fns using
+        incubate.moe_aux_loss() keep working under pp."""
         outer_named, blocks, leaf_names, decomp = self._pp_split()
         mesh = mesh_mod.get_mesh()
         template = blocks[0]
         M = self.n_microbatches
         remat = bool(decomp.get("remat", False))
 
+        buf_leaf_names = [n for n, _ in blocks[0].named_buffers()]
+        stacked_all = dict(stacked)
+        if buf_leaf_names:
+            # called inside compute_loss's model-level _swapped: each block
+            # buffer's ._array IS the traced per-model buffer argument
+            order = self._block_order(len(blocks))
+            lps = len(blocks) // self.pp
+            per_block = [dict(b.named_buffers()) for b in blocks]
+            for ln in buf_leaf_names:
+                arrs = [per_block[i][ln]._array for i in order]
+                stacked_all["buf::" + ln] = jnp.stack(arrs).reshape(
+                    (self.pp, lps) + arrs[0].shape)
+
+        from ..incubate.nn.moe import MoELayer
+        moes = [l for b in blocks for l in b.sublayers(include_self=True)
+                if isinstance(l, MoELayer)]
+
         def block_apply(leaf_dict, h, key):
             arrs = [leaf_dict[n] for n in leaf_names]
-            with FB._swapped(template, leaf_names, arrs, [], []):
+            bufs = [leaf_dict["buf::" + n] for n in buf_leaf_names]
+            with FB._swapped(template, leaf_names, arrs,
+                             buf_leaf_names, bufs) as (_, tbufs):
                 with _random.key_context(key):
                     out = template(Tensor._from_array(h))
-            return out._array
+                # mutation check must run BEFORE _swapped restores arrays
+                for n, orig in zip(buf_leaf_names, bufs):
+                    if tbufs[n]._array is not orig:
+                        raise NotImplementedError(
+                            f"pipelined block mutates buffer '{n}' "
+                            f"(train-mode BatchNorm running stats?): "
+                            f"buffers are read-only inside the pipelined "
+                            f"scan — set such layers to eval or keep them "
+                            f"outside the blocks")
+            aux = jnp.zeros((), jnp.float32)
+            for l in template.sublayers(include_self=True):
+                if isinstance(l, MoELayer) and l.aux_loss is not None:
+                    aux = aux + l.aux_loss._array.astype(jnp.float32)
+                    l.restore_aux_loss(None)  # don't leak tracers
+            return out._array, aux
 
         if remat:
             block_apply = jax.checkpoint(block_apply)
@@ -462,10 +494,16 @@ class DistributedTrainStep:
             if mesh_mod.degree("dp") > 1:
                 x_mb = jax.lax.with_sharding_constraint(
                     x_mb, NamedSharding(mesh, P(None, "dp")))
-            y_mb = pipeline_apply_hybrid(
-                block_apply, stacked, x_mb, rng, mesh,
+            y_mb, aux_total = pipeline_apply_hybrid(
+                block_apply, stacked_all, x_mb, rng, mesh,
                 n_stages=self.pp, n_microbatches=M, n_chunks=self.vpp)
             y = y_mb.reshape((B,) + y_mb.shape[2:])
+            if moes:
+                # per-microbatch means averaged over M == full-batch mean
+                for l in moes:
+                    l.restore_aux_loss(None)
+                moes[0].restore_aux_loss(
+                    Tensor._from_array(aux_total / float(M)))
             return decomp["post"](Tensor._from_array(y))
 
         return run
@@ -582,6 +620,41 @@ class DistributedTrainStep:
         self._jitted = jax.jit(step_fn, in_shardings=in_sh,
                                out_shardings=out_sh,
                                donate_argnums=(0, 2))
+
+    def memory_stats(self, *batch):
+        """AOT-compile the fused step for `batch` and return XLA's
+        CompiledMemoryStats (argument/output/temp bytes) WITHOUT running
+        it — the peak-memory evidence for pipeline schedule choices
+        (tools/pp_memory.py; reference analog: 1F1B's activation-memory
+        motivation in fleet pipeline_parallel.py)."""
+        model, optimizer = self.model, self.optimizer
+        if not self._placed:
+            self._place_state()
+        batch_arrays = tuple(
+            b._array if isinstance(b, Tensor) else jnp.asarray(b)
+            for b in batch)
+        if self._jitted is None:
+            self._build(batch_arrays)
+        if self.use_pp:
+            outer_named, _, leaf_names, _ = self._pp_split()
+            param_tree = ([p._array for _, p in outer_named], self._stacked)
+        else:
+            _, pa, _, _ = FB.split_state(model)
+            param_tree = pa
+        batch_arrays = self._globalize_batch(batch_arrays)
+        ba = [b._array for _, b in model.named_buffers()]
+        lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
+        step = jnp.asarray(self._step + 1, jnp.float32)
+        # observational: a throwaway key with the right aval, NOT a draw
+        # from the shared stream (would perturb later training randomness)
+        st = _random.get_rng_state()
+        try:
+            rng = _random.next_key()
+        finally:
+            _random.set_rng_state(st)
+        return self._jitted.lower(
+            param_tree, ba, self._opt_state, lr, step, rng,
+            batch_arrays).compile().memory_analysis()
 
     def __call__(self, *batch):
         model, optimizer = self.model, self.optimizer

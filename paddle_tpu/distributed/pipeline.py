@@ -95,11 +95,14 @@ def gpipe_hybrid(block_apply, n_stages, n_microbatches, axis_name="pp"):
     inside the pipelined region (reference analog: Fleet composing
     PipelineParallel with NCCL tp/dp groups — here XLA composes them).
 
-    block_apply(leaf_dict, x, key) -> y  runs ONE block on one microbatch.
-    Returns pipelined(stacked_params, x_mb, key) for use under
-    ``jax.shard_map(..., axis_names={axis_name})`` where stacked leaves are
-    [n_stages, layers_per_stage, ...] (leading axis sharded over pp) and
-    x_mb is [M, mb, ...].
+    block_apply(leaf_dict, x, key) -> (y, aux) runs ONE block on one
+    microbatch; `aux` is a scalar side loss (MoE router load-balance —
+    zero for dense blocks) accumulated over every ACTIVE schedule step so
+    router losses escape the pipelined scan.
+    Returns pipelined(stacked_params, x_mb, key) -> (out, aux_total) for
+    use under ``jax.shard_map(..., axis_names={axis_name})`` where stacked
+    leaves are [n_stages, layers_per_stage, ...] (leading axis sharded
+    over pp) and x_mb is [M, mb, ...].
 
     NOTE: partial-manual shard_map only lowers under jit in current jax —
     the fleet engine always calls this inside its pjit'd step.
@@ -108,14 +111,17 @@ def gpipe_hybrid(block_apply, n_stages, n_microbatches, axis_name="pp"):
     def stage_fn(stage_params, x, key):
         n_layers = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
 
-        def scan_block(h, xs):
+        def scan_block(carry, xs):
+            h, aux = carry
             layer_params, li = xs
             k = jax.random.fold_in(key, li)
-            return block_apply(layer_params, h, k), None
+            y, a = block_apply(layer_params, h, k)
+            return (y, aux + a), None
 
-        y, _ = lax.scan(scan_block, x,
-                        (stage_params, jnp.arange(n_layers)))
-        return y
+        (y, aux), _ = lax.scan(scan_block,
+                               (x, jnp.zeros((), jnp.float32)),
+                               (stage_params, jnp.arange(n_layers)))
+        return y, aux
 
     def pipelined(stacked_params, x_mb, key):
         # under shard_map the pp axis is manual: leading dim == 1 here
@@ -128,12 +134,17 @@ def gpipe_hybrid(block_apply, n_stages, n_microbatches, axis_name="pp"):
 
         out_buf = jnp.zeros((M,) + mb_shape, x_mb.dtype)
         state = jnp.zeros(mb_shape, x_mb.dtype)
+        aux_acc = jnp.zeros((), jnp.float32)
 
         def body(carry, t):
-            state, out_buf = carry
+            state, out_buf, aux_acc = carry
             inject = x_mb[jnp.clip(t, 0, M - 1)]
             cur = jnp.where(idx == 0, inject, state)
-            y = stage_fn(my_params, cur, jax.random.fold_in(key, t))
+            y, aux = stage_fn(my_params, cur, jax.random.fold_in(key, t))
+            # stage idx holds microbatch t-idx at step t: only those
+            # steps' aux are real work (bubble steps chew zeros/garbage)
+            active = (t >= idx) & (t < idx + M)
+            aux_acc = aux_acc + jnp.where(active, aux, 0.0)
             emit_t = jnp.clip(t - (P_ - 1), 0, M - 1)
             is_emit = (t >= P_ - 1) & (idx == P_ - 1)
             prev = lax.dynamic_index_in_dim(out_buf, emit_t, 0,
@@ -142,14 +153,15 @@ def gpipe_hybrid(block_apply, n_stages, n_microbatches, axis_name="pp"):
             out_buf = lax.dynamic_update_index_in_dim(out_buf, upd, emit_t, 0)
             perm = [(i, (i + 1) % P_) for i in range(P_)]
             state = lax.ppermute(y, axis_name, perm)
-            return (state, out_buf), None
+            return (state, out_buf, aux_acc), None
 
-        (state, out_buf), _ = lax.scan(body, (state, out_buf),
-                                       jnp.arange(T))
+        (state, out_buf, aux_acc), _ = lax.scan(
+            body, (state, out_buf, aux_acc), jnp.arange(T))
         out = lax.psum(
             jnp.where(idx == P_ - 1, out_buf,
                       jnp.zeros_like(out_buf)), axis_name)
-        return out[None]
+        aux_total = lax.psum(aux_acc, axis_name)
+        return out[None], aux_total
 
     return pipelined
 
@@ -213,22 +225,28 @@ def interleaved_hybrid(block_apply, n_stages, n_microbatches, n_chunks,
         state = jnp.zeros(mb_shape, x_mb.dtype)
         fifo = jnp.zeros((D + 1,) + mb_shape, x_mb.dtype)
 
+        aux_acc = jnp.zeros((), jnp.float32)
+
         def chunk_params(v):
             return jax.tree_util.tree_map(
                 lambda a: lax.dynamic_slice_in_dim(a, v * lpc, lpc, 0),
                 my_params)
 
         def stage_fn(cparams, x, v, k):
-            def scan_block(h, xs):
+            def scan_block(carry, xs):
+                h, aux = carry
                 layer_params, li = xs
                 kk = jax.random.fold_in(k, v * lpc + li)
-                return block_apply(layer_params, h, kk), None
+                y, a = block_apply(layer_params, h, kk)
+                return (y, aux + a), None
 
-            y, _ = lax.scan(scan_block, x, (cparams, jnp.arange(lpc)))
-            return y
+            (y, aux), _ = lax.scan(scan_block,
+                                   (x, jnp.zeros((), jnp.float32)),
+                                   (cparams, jnp.arange(lpc)))
+            return y, aux
 
         def body(carry, t):
-            state, out_buf, fifo = carry
+            state, out_buf, fifo, aux_acc = carry
             rel = t - idx
             v = jnp.clip(rel // M, 0, V - 1)
             m = jnp.clip(rel % M, 0, M - 1)
@@ -245,7 +263,11 @@ def interleaved_hybrid(block_apply, n_stages, n_microbatches, n_chunks,
             inject = x_mb[m]
             h0 = jnp.where(v == 0, inject, delayed)
             h = jnp.where(idx == 0, h0, state)
-            y = stage_fn(chunk_params(v), h, v, jax.random.fold_in(key, t))
+            y, aux = stage_fn(chunk_params(v), h, v,
+                              jax.random.fold_in(key, t))
+            # device idx works (chunk v, microbatch m) when 0 <= t-idx < V*M
+            active = (rel >= 0) & (rel < V * M)
+            aux_acc = aux_acc + jnp.where(active, aux, 0.0)
             m_emit = jnp.clip(t - (V - 1) * M - (P_ - 1), 0, M - 1)
             is_emit = (idx == P_ - 1) & (t >= (V - 1) * M + P_ - 1)
             prev = lax.dynamic_index_in_dim(out_buf, m_emit, 0,
@@ -254,14 +276,15 @@ def interleaved_hybrid(block_apply, n_stages, n_microbatches, n_chunks,
                 out_buf, jnp.where(is_emit, y, prev), m_emit, 0)
             perm = [(i, (i + 1) % P_) for i in range(P_)]
             state = lax.ppermute(y, axis_name, perm)
-            return (state, out_buf, fifo), None
+            return (state, out_buf, fifo, aux_acc), None
 
-        (state, out_buf, fifo), _ = lax.scan(
-            body, (state, out_buf, fifo), jnp.arange(T))
+        (state, out_buf, fifo, aux_acc), _ = lax.scan(
+            body, (state, out_buf, fifo, aux_acc), jnp.arange(T))
         out = lax.psum(
             jnp.where(idx == P_ - 1, out_buf,
                       jnp.zeros_like(out_buf)), axis_name)
-        return out[None]
+        aux_total = lax.psum(aux_acc, axis_name)
+        return out[None], aux_total
 
     return pipelined
 
@@ -271,7 +294,9 @@ def pipeline_apply_hybrid(block_apply, stacked_params, x_mb, key, mesh,
                           n_chunks=1):
     """Run the hybrid pipeline schedule (GPipe, or interleaved when
     n_chunks > 1); must be called inside jit (the fleet engine's pjit
-    step).  x_mb: [M, mb, ...]; returns [M, mb, ...]."""
+    step).  x_mb: [M, mb, ...]; returns ([M, mb, ...], aux_total) where
+    aux_total sums block aux losses (MoE routers) over all stages and
+    microbatches."""
     if n_chunks > 1:
         fn = interleaved_hybrid(block_apply, n_stages, n_microbatches,
                                 n_chunks, axis_name)
@@ -281,9 +306,10 @@ def pipeline_apply_hybrid(block_apply, stacked_params, x_mb, key, mesh,
         lambda _: P(axis_name), stacked_params)
     mapped = jax.shard_map(fn, mesh=mesh,
                            in_specs=(param_specs, P(), P()),
-                           out_specs=P(axis_name),
+                           out_specs=(P(axis_name), P()),
                            axis_names={axis_name}, check_vma=False)
-    return mapped(stacked_params, x_mb, key)[0]
+    out, aux = mapped(stacked_params, x_mb, key)
+    return out[0], aux
 
 
 class PipelineLayer:

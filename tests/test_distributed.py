@@ -295,7 +295,8 @@ def test_interleaved_pipeline_matches_serial_low_level():
     b = jnp.asarray(np.random.randn(L, D) * 0.1, jnp.float32)
 
     def block_apply(lp, h, key):
-        return jnp.tanh(h @ lp["w"] + lp["b"])
+        # round-3 contract: (y, aux scalar) — aux carries MoE router losses
+        return jnp.tanh(h @ lp["w"] + lp["b"]), jnp.zeros((), jnp.float32)
 
     # device p rows: chunk v covers virtual stage v*P+p (lpc layers each)
     order = np.asarray([(j // lpc * P_ + p) * lpc + j % lpc
@@ -308,9 +309,10 @@ def test_interleaved_pipeline_matches_serial_low_level():
 
     @jax.jit
     def run(stacked, x, key):
-        return pipeline_apply_hybrid(block_apply, stacked, x, key, mesh,
-                                     n_stages=P_, n_microbatches=M,
-                                     n_chunks=V)
+        out, _aux = pipeline_apply_hybrid(block_apply, stacked, x, key,
+                                          mesh, n_stages=P_,
+                                          n_microbatches=M, n_chunks=V)
+        return out
 
     out = run(stacked, x, key)
     ref = x

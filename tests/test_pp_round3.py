@@ -1,0 +1,230 @@
+"""Round-3 pipeline-parallel extensions (reference: fleet meta_parallel
+pipeline_parallel.py): pp x MoE (router aux escapes the pipelined scan),
+read-only buffers inside pipelined blocks, and compiled peak-memory
+evidence for the remat'd GPipe schedule."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed import mesh as mesh_mod
+
+
+@pytest.fixture
+def restore_mesh():
+    prev = dict(mesh_mod._state)
+    yield
+    mesh_mod._state.update(prev)
+
+
+def _moe_gpt(seed=13, layers=4):
+    from paddle_tpu.text import GPTConfig, GPTForCausalLM
+    pt.seed(seed)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=layers,
+                    num_heads=4, max_position_embeddings=32,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    tensor_parallel=False, num_experts=4,
+                    moe_capacity_factor=4.0)   # no token dropping
+    return GPTForCausalLM(cfg)
+
+
+def test_fleet_pp_moe_matches_microbatched_serial(restore_mesh):
+    """pp=2 x MoE: CE over the full batch + aux averaged over microbatches
+    must equal the same computation done serially per microbatch (gating
+    statistics are per-microbatch under pp — the reference's semantics)."""
+    from paddle_tpu.text import gpt_loss_fn
+    from paddle_tpu.incubate.nn import moe_aux_loss
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 2, "accumulate_steps": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    m_pp = _moe_gpt()
+    m_ref = _moe_gpt(seed=99)
+    m_ref.set_state_dict(m_pp.state_dict())
+
+    o_pp = pt.optimizer.Adam(learning_rate=0.02,
+                             parameters=m_pp.parameters())
+    step = fleet.build_train_step(m_pp, gpt_loss_fn, o_pp)
+    o_ref = pt.optimizer.Adam(learning_rate=0.02,
+                              parameters=m_ref.parameters())
+
+    pt.seed(7)
+    M = 2
+    ids = pt.randint(0, 64, [4, 16])
+    labels = pt.randint(0, 64, [4, 16])
+    import paddle_tpu.nn.functional as F
+    w = m_ref.cfg.moe_aux_weight
+
+    for _ in range(2):
+        pp_loss = step(ids, labels)
+        # reference: full-batch CE + microbatch-averaged router aux
+        logits_parts, auxes = [], []
+        for mb in range(M):
+            sl = slice(mb * 2, (mb + 1) * 2)
+            logits_parts.append(m_ref(ids[sl]))
+            auxes.append(moe_aux_loss(m_ref))
+        logits = pt.concat(logits_parts, axis=0)
+        ce = F.cross_entropy(logits, labels, reduction="mean")
+        aux = sum(auxes[1:], auxes[0]) / float(M)
+        ref_loss = ce + w * aux
+        ref_loss.backward()
+        o_ref.step(); o_ref.clear_grad()
+        np.testing.assert_allclose(float(pp_loss), float(ref_loss),
+                                   rtol=3e-4)
+    step.sync_model()
+    ref_params = dict(m_ref.named_parameters())
+    for n, p in m_pp.named_parameters():
+        np.testing.assert_allclose(p.numpy(), ref_params[n].numpy(),
+                                   rtol=2e-3, atol=5e-4,
+                                   err_msg=n)
+
+
+class _ScaledBlock(pt.nn.Layer):
+    """Homogeneous block holding a READ-ONLY buffer used in forward."""
+
+    def __init__(self, d, scale):
+        super().__init__()
+        self.fc = pt.nn.Linear(d, d)
+        self.register_buffer("scale", pt.to_tensor(np.float32(scale)))
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        return x + F.gelu(self.fc(x)) * self.scale
+
+
+class _BufferedNet(pt.nn.Layer):
+    def __init__(self, d=16, n=4):
+        super().__init__()
+        self.inp = pt.nn.Linear(d, d)
+        self.blocks = pt.nn.LayerList(
+            [_ScaledBlock(d, 0.5 + 0.25 * i) for i in range(n)])
+        self.head = pt.nn.Linear(d, d)
+
+    def forward(self, x):
+        h = self.inp(x)
+        for b in self.blocks:
+            h = b(h)
+        return self.head(h)
+
+    def pipeline_decompose(self):
+        return {"blocks": list(self.blocks),
+                "pre": lambda x: self.inp(x),
+                "post": lambda h: self.head(h)}
+
+
+def _mse_loss(model, x, y):
+    out = model(x)
+    return ((out - y) ** 2).mean()
+
+
+def test_pp_blocks_with_readonly_buffers(restore_mesh):
+    """Round-2 restriction lifted: per-block buffers ride the pipelined
+    scan read-only; pp training == serial eager training."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 2, "accumulate_steps": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    pt.seed(5)
+    m_pp = _BufferedNet()
+    pt.seed(6)
+    m_ref = _BufferedNet()
+    m_ref.set_state_dict(m_pp.state_dict())
+
+    o_pp = pt.optimizer.SGD(learning_rate=0.1,
+                            parameters=m_pp.parameters())
+    step = fleet.build_train_step(m_pp, _mse_loss, o_pp)
+    o_ref = pt.optimizer.SGD(learning_rate=0.1,
+                             parameters=m_ref.parameters())
+
+    rng = np.random.default_rng(0)
+    x = pt.to_tensor(rng.standard_normal((4, 16)).astype(np.float32))
+    y = pt.to_tensor(rng.standard_normal((4, 16)).astype(np.float32))
+    for _ in range(3):
+        pp_loss = step(x, y)
+        ref_loss = _mse_loss(m_ref, x, y)
+        ref_loss.backward()
+        o_ref.step(); o_ref.clear_grad()
+        np.testing.assert_allclose(float(pp_loss), float(ref_loss),
+                                   rtol=2e-5)
+    step.sync_model()
+    ref_params = dict(m_ref.named_parameters())
+    for n, p in m_pp.named_parameters():
+        np.testing.assert_allclose(p.numpy(), ref_params[n].numpy(),
+                                   rtol=1e-4, atol=1e-5, err_msg=n)
+
+
+class _BNBlock(pt.nn.Layer):
+    def __init__(self, d):
+        super().__init__()
+        self.fc = pt.nn.Linear(d, d)
+        self.bn = pt.nn.BatchNorm1D(d)
+
+    def forward(self, x):
+        return self.bn(self.fc(x))
+
+
+class _BNNet(pt.nn.Layer):
+    def __init__(self, d=8, n=2):
+        super().__init__()
+        self.blocks = pt.nn.LayerList([_BNBlock(d) for _ in range(n)])
+
+    def forward(self, x):
+        for b in self.blocks:
+            x = b(x)
+        return x
+
+    def pipeline_decompose(self):
+        return {"blocks": list(self.blocks),
+                "pre": lambda x: x,
+                "post": lambda h: h}
+
+
+def test_pp_block_buffer_mutation_raises(restore_mesh):
+    """Train-mode BatchNorm inside a pipelined block must fail loudly
+    (running-stat updates cannot cross the scan), not silently drop."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 2, "accumulate_steps": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    pt.seed(1)
+    m = _BNNet()
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    step = fleet.build_train_step(m, _mse_loss, opt)
+    x = pt.to_tensor(np.ones((4, 8), np.float32))
+    with pytest.raises(NotImplementedError, match="read-only"):
+        step(x, x)
+
+
+def test_pp_memory_stats_remat_lever(restore_mesh):
+    """Compiled peak-memory evidence: the remat'd GPipe scan compiles to a
+    significantly smaller temp footprint than the non-remat one (the lever
+    that substitutes for a hand-written 1F1B schedule); both are
+    measurable via the engine's AOT memory_stats()."""
+    from paddle_tpu.text import GPTConfig, GPTForCausalLM, gpt_loss_fn
+
+    stats = {}
+    for remat in (False, True):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": 2, "accumulate_steps": 4}
+        fleet.init(is_collective=True, strategy=strategy)
+        pt.seed(3)
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=4,
+                        num_heads=4, max_position_embeddings=64,
+                        hidden_dropout=0.0, attention_dropout=0.0,
+                        use_recompute=remat, tensor_parallel=False)
+        m = GPTForCausalLM(cfg)
+        opt = pt.optimizer.SGD(learning_rate=0.01,
+                               parameters=m.parameters())
+        step = fleet.build_train_step(m, gpt_loss_fn, opt)
+        ids = pt.randint(0, 128, [8, 64])
+        ms = step.memory_stats(ids, ids)
+        assert ms.temp_size_in_bytes > 0
+        stats[remat] = ms.temp_size_in_bytes
+
+    # remat must cut the scan's held activations (bb-for-memory trade);
+    # the margin is the point, not the exact ratio
+    assert stats[True] < stats[False] * 0.75, stats
