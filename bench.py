@@ -130,6 +130,15 @@ def run_gpt(preset, seq_len, batch, steps=20, warmup=3, **cfg_kw):
     final = float(loss._array)  # forces the donated-chain sequence
     dt = time.perf_counter() - t0
 
+    # corroboration (VERDICT r2: bench evidence was single-sourced): a
+    # per-step loss series measured AFTER the timing block (per-step host
+    # reads would serialize the device queue and poison the tokens/s)
+    series, stimes = [], []
+    for _ in range(5):
+        ts = time.perf_counter()
+        series.append(float(step(ids, labels)._array))
+        stimes.append(round(time.perf_counter() - ts, 4))
+
     tokens = batch * seq_len * steps
     n_params = sum(p.size for p in model.parameters())
     # MoE: per-token ACTIVE params (dense share + top_k/E of the experts)
@@ -143,7 +152,18 @@ def run_gpt(preset, seq_len, batch, steps=20, warmup=3, **cfg_kw):
                       + layer.b2.size)
                 active -= int(ep * (1.0 - layer.top_k / layer.num_experts))
     return {"tps": tokens / dt, "n_params": int(n_params),
-            "active_params": int(active), "loss": final}
+            "active_params": int(active), "loss": final,
+            "loss_series": [round(v, 4) for v in series],
+            "step_times_s": stimes, "devices": _dev_str()}
+
+
+def _dev_str():
+    import jax
+    try:
+        d = jax.devices()[0]
+        return f"{getattr(d, 'device_kind', d.platform)} x{jax.device_count()}"
+    except Exception:  # pragma: no cover
+        return "?"
 
 
 def run_resnet(batch=256, steps=20, warmup=3, s2d_stem=True):
@@ -175,7 +195,9 @@ def run_resnet(batch=256, steps=20, warmup=3, s2d_stem=True):
         loss = step(x, y)
     final = float(loss._array)
     dt = time.perf_counter() - t0
-    return {"ips": batch * steps / dt, "loss": final}
+    series = [round(float(step(x, y)._array), 4) for _ in range(5)]
+    return {"ips": batch * steps / dt, "loss": final,
+            "loss_series": series, "devices": _dev_str()}
 
 
 def run_llama(steps=10, warmup=2, hidden=2048, layers=16, heads=16,
@@ -222,9 +244,10 @@ def run_llama(steps=10, warmup=2, hidden=2048, layers=16, heads=16,
         loss = step(ids, labels)
     final = float(loss._array)
     dt = time.perf_counter() - t0
+    series = [round(float(step(ids, labels)._array), 4) for _ in range(3)]
     n_params = sum(p.size for p in model.parameters())
     return {"tps": batch * seq * steps / dt, "n_params": int(n_params),
-            "loss": final}
+            "loss": final, "loss_series": series, "devices": _dev_str()}
 
 
 def run_moe(steps=10, warmup=2, preset="gpt3-350M", experts=8, top_k=2,
@@ -277,6 +300,42 @@ def _spawn(spec, timeout):
 
 
 # ================================================================== parent
+def _archive(record):
+    """Persist corroborating evidence (loss series, per-step times, device
+    string) from every successful chip run into bench_results/ so an
+    archived headline is auditable (VERDICT r2 item 1)."""
+    try:
+        d = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "bench_results")
+        os.makedirs(d, exist_ok=True)
+        # one file per bench invocation (stable name: re-archiving after
+        # later legs overwrites, not duplicates)
+        stamp = record["ts"].replace(":", "").replace("-", "")
+        path = os.path.join(d, f"r3_{stamp}.json")
+        with open(path, "w") as f:
+            json.dump(record, f, indent=1)
+        _log(f"# archived evidence -> {path}")
+    except Exception as e:  # pragma: no cover
+        _log(f"# archive failed: {e}")
+
+
+def _probe_with_retry_window():
+    """Probe immediately; on failure keep re-probing on an interval across
+    the budget (a transient claim outage at capture time must not zero the
+    round), leaving enough budget for one headline preset."""
+    interval = int(os.environ.get("BENCH_PROBE_INTERVAL", "600"))
+    reserve = PROBE_TIMEOUT + 420  # one probe + smallest GPT leg + slack
+    while True:
+        if probe_backend():
+            return True
+        wait = min(interval, _left() - reserve)
+        if wait <= 0 or _left() < reserve:
+            return False
+        _log(f"# claim down; re-probing in {wait:.0f}s "
+             f"({_left():.0f}s budget left)")
+        time.sleep(wait)
+
+
 def main():
     child = os.environ.get("BENCH_CHILD")
     if child:
@@ -284,7 +343,9 @@ def main():
         return
 
     headline = None
-    if not probe_backend():
+    record = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+              "legs": {}}
+    if not _probe_with_retry_window():
         # value stays 0 — we never report an unmeasured number as current.
         # last_measured points at the archived in-repo record of the most
         # recent successful run so a claim outage at bench time doesn't
@@ -297,11 +358,12 @@ def main():
                 os.path.dirname(os.path.abspath(__file__)),
                 "bench_results", "*.json")), key=os.path.getmtime,
                 reverse=True)
-            for rec in recs:   # newest record that actually has a headline
+            for rec in recs:   # newest record with a MEASURED headline
                 with open(rec) as f:
                     stale = json.load(f).get("headline")
-                if stale:
+                if stale and stale.get("value"):   # skip 0.0 placeholders
                     break
+                stale = None
         except Exception:
             pass
         print(json.dumps({
@@ -338,6 +400,8 @@ def main():
                 "unit": "tokens/s/chip",
                 "vs_baseline": round(tps / baseline, 3),
             }
+            record["legs"]["gpt"] = {**res, "preset": preset,
+                                     "mfu": round(mfu, 4)}
             _log(f"# gpt {preset}: params={n_params/1e9:.2f}B "
                  f"loss={res['loss']:.3f} batch={batch} seq={seq} "
                  f"tokens/s={tps:.1f} MFU={mfu*100:.1f}% "
@@ -351,6 +415,8 @@ def main():
     # print the headline BEFORE the secondary legs so an external kill
     # mid-resnet/llama can't lose the measured number (round-1 rc=124)
     print(json.dumps(headline), flush=True)
+    record["headline"] = headline
+    _archive(record)   # evidence survives even if a later leg wedges
 
     # ---- secondary legs (stderr json so the driver tail records them)
     if _left() > 400:
@@ -359,6 +425,7 @@ def main():
                                                   "256"))},
                      min(PRESET_TIMEOUT, _left()))
         if res:
+            record["legs"]["resnet"] = res
             _log(json.dumps({
                 "metric": "ResNet-50 train images/sec/chip",
                 "value": round(res["ips"], 1), "unit": "images/s/chip",
@@ -367,6 +434,7 @@ def main():
     if _left() > 400:
         res = _spawn({"kind": "llama"}, min(PRESET_TIMEOUT, _left()))
         if res:
+            record["legs"]["llama"] = res
             base = A100_GPT13_TOKENS_PER_SEC * (1.3e9 / max(res["n_params"],
                                                             1))
             _log(json.dumps({
@@ -377,6 +445,7 @@ def main():
     if _left() > 400:
         res = _spawn({"kind": "moe"}, min(PRESET_TIMEOUT, _left()))
         if res:
+            record["legs"]["moe"] = res
             # baseline scaled by ACTIVE (per-token) params, matching the
             # dense legs' compute-for-compute methodology
             act = res.get("active_params") or res["n_params"]
@@ -387,6 +456,24 @@ def main():
                 "vs_baseline": round(res["tps"] / base, 3),
                 "total_params": res["n_params"],
                 "active_params": act}))
+    if _left() > 500 and os.environ.get("BENCH_SKIP_27B") != "1":
+        # model-ladder leg above the headline (VERDICT r2 item 8):
+        # GPT-2.7B, Adafactor + recompute + pure bf16 (~5.4GB params)
+        res = _spawn({"kind": "gpt", "preset": "gpt3-2.7B",
+                      "seq_len": 1024, "batch": 2, "steps": 10,
+                      "use_recompute": True},
+                     min(PRESET_TIMEOUT, _left()))
+        if res:
+            record["legs"]["gpt27"] = res
+            mfu = 6.0 * res["n_params"] * res["tps"] / (PEAK_TFLOPS * 1e12)
+            base = A100_GPT13_TOKENS_PER_SEC * (1.3e9 / res["n_params"])
+            _log(json.dumps({
+                "metric": "GPT(gpt3-2.7B, seq1024, recompute) train "
+                          "tokens/sec/chip",
+                "value": round(res["tps"], 1), "unit": "tokens/s/chip",
+                "vs_baseline": round(res["tps"] / base, 3),
+                "mfu": round(mfu, 4)}))
+    _archive(record)
 
 
 if __name__ == "__main__":
