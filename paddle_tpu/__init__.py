@@ -49,6 +49,8 @@ from . import inference  # noqa: F401
 from . import version  # noqa: F401
 from . import sysconfig  # noqa: F401
 from . import base  # noqa: F401
+from .base import CUDAPlace  # noqa: F401  (accelerator place alias)
+from . import hub  # noqa: F401
 fluid = base  # legacy namespace alias (paddle.fluid)
 import sys as _sys
 # register the alias as a real module so `import paddle_tpu.fluid` and

@@ -14,7 +14,11 @@ from .mesh import build_mesh, get_mesh, set_mesh  # noqa: F401
 from .collective import (  # noqa: F401
     ReduceOp, all_reduce, all_gather, reduce_scatter, broadcast, scatter,
     alltoall, alltoall_single, barrier, ppermute, stream_synchronize,
+    reduce, send, recv, isend, irecv, all_gather_object,
+    broadcast_object_list, scatter_object_list, get_group,
+    destroy_process_group, split,
 )
+from . import launch  # noqa: F401
 from .recompute import recompute  # noqa: F401
 from .parallel_layers import (  # noqa: F401
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,

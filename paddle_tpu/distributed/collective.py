@@ -236,7 +236,10 @@ def send(tensor, dst=0, group=None):
         "use paddle_tpu.distributed.ppermute")
 
 
-recv = send
+def recv(tensor, src=0, group=None):
+    raise NotImplementedError(
+        "point-to-point send/recv maps to lax.ppermute inside shard_map; "
+        "use paddle_tpu.distributed.ppermute")
 
 
 def ppermute(x, axis_name, perm):
@@ -251,3 +254,110 @@ def barrier(group=None):
 
 def stream_synchronize():
     barrier()
+
+
+# ------------------------------------------------ round-3 API-audit adds
+def _world_size():
+    from . import get_world_size
+    return get_world_size()
+
+
+def _my_rank():
+    from . import get_rank
+    return get_rank()
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """paddle.distributed.reduce: result on dst.  Single-controller SPMD
+    keeps replicated values on every shard, so this is all_reduce with the
+    reference signature (dst sees the reduced value; others too)."""
+    return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst=dst, group=group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src=src, group=group)
+
+
+def _object_to_tensor(obj):
+    import pickle
+    import numpy as np
+    data = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+    return data
+
+
+def all_gather_object(object_list, obj, group=None):
+    """Single-process: the local object IS the gathered set per rank; on a
+    multi-process launch, gathers via the host allgather helper."""
+    import jax
+    if jax.process_count() == 1:
+        object_list.extend([obj] * max(1, _world_size()))
+        return
+    import pickle
+    from jax.experimental import multihost_utils
+    data = _object_to_tensor(obj)
+    padded = multihost_utils.process_allgather(data)
+    object_list.extend(pickle.loads(bytes(row)) for row in padded)
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    import jax
+    if jax.process_count() == 1:
+        return object_list
+    import pickle
+    import numpy as np
+    from jax.experimental import multihost_utils
+    # two-phase: lengths differ across ranks (non-src pass placeholders),
+    # and broadcast_one_to_all needs identical shapes — broadcast the
+    # src blob LENGTH first, then the zero-padded blob
+    blob = _object_to_tensor(list(object_list))
+    n = int(multihost_utils.broadcast_one_to_all(
+        np.asarray(blob.shape[0], np.int64)))
+    padded = np.zeros(n, np.uint8)
+    padded[:min(n, blob.shape[0])] = blob[:n]
+    out = multihost_utils.broadcast_one_to_all(padded)
+    object_list[:] = pickle.loads(bytes(np.asarray(out)))
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    import jax
+    if jax.process_count() == 1:
+        rank = _my_rank()
+        out_object_list.append(
+            in_object_list[rank if rank < len(in_object_list) else 0])
+        return
+    raise NotImplementedError(
+        "scatter_object_list across processes: use broadcast_object_list "
+        "+ local slicing")
+
+
+class _Group:
+    def __init__(self, ranks, gid=0):
+        self.ranks = list(ranks)
+        self.nranks = len(self.ranks)
+        self.id = gid
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+
+def get_group(gid=0):
+    return _Group(range(_world_size()), gid)
+
+
+def destroy_process_group(group=None):
+    """Tear-down parity; XLA collectives hold no persistent group state."""
+    return None
+
+
+def split(tensor, num_or_sections, axis=0, group=None):
+    """paddle.distributed.split of a weight across model-parallel ranks —
+    under GSPMD, sharding annotations replace explicit splits; provided
+    for API parity as a local split."""
+    from ..tensor_api import split as _split
+    return _split(tensor, num_or_sections, axis=axis)

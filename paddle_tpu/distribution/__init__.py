@@ -14,7 +14,9 @@ from ..tensor import Tensor
 
 __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
            "Beta", "Dirichlet", "Multinomial", "Exponential", "Laplace",
-           "LogNormal", "Gumbel", "Gamma", "kl_divergence", "register_kl"]
+           "LogNormal", "Gumbel", "Gamma", "kl_divergence", "register_kl",
+           "Cauchy", "ExponentialFamily", "Geometric", "Independent",
+           "TransformedDistribution"]
 
 
 def _arr(x, dtype=jnp.float32):
@@ -450,3 +452,116 @@ def _kl_beta(p, q):
 @register_kl(Exponential, Exponential)
 def _kl_exponential(p, q):
     return _wrap(jnp.log(p.rate / q.rate) + q.rate / p.rate - 1)
+
+
+# ------------------------------------------------ round-3 API-audit adds
+class ExponentialFamily(Distribution):
+    """Marker base for exponential-family distributions (reference:
+    paddle.distribution.ExponentialFamily; Bregman-divergence entropy via
+    the log-normalizer is not re-derived here — subclasses implement
+    entropy directly)."""
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def _bshape(self):
+        return jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        u = jax.random.uniform(key, _shape(shape) + self._bshape(),
+                               jnp.float32, 1e-6, 1.0 - 1e-6)
+        return _wrap(self.loc + self.scale * jnp.tan(jnp.pi * (u - 0.5)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        z = (v - self.loc) / self.scale
+        return _wrap(-jnp.log(jnp.pi * self.scale * (1.0 + z * z)))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(
+            jnp.log(4 * jnp.pi * self.scale), self._bshape()))
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before first success)."""
+
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is None:
+            probs = jax.nn.sigmoid(_arr(logits))
+        self.probs = _arr(probs)
+
+    @property
+    def mean(self):
+        return _wrap((1.0 - self.probs) / self.probs)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        u = jax.random.uniform(key, _shape(shape) + self.probs.shape,
+                               jnp.float32, 1e-7, 1.0 - 1e-7)
+        return _wrap(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(v * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+    def entropy(self):
+        p = self.probs
+        return _wrap(-((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p)
+
+
+class Independent(Distribution):
+    """Reinterprets the rightmost `reinterpreted_batch_rank` batch dims of
+    a base distribution as event dims (reference:
+    paddle.distribution.Independent)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = _arr(self.base.log_prob(value))
+        return _wrap(lp.sum(axis=tuple(range(-self.rank, 0))))
+
+    def entropy(self):
+        e = _arr(self.base.entropy())
+        return _wrap(e.sum(axis=tuple(range(-self.rank, 0))))
+
+
+class TransformedDistribution(Distribution):
+    """Distribution of T(X) for invertible transforms T (reference:
+    paddle.distribution.TransformedDistribution).  Transforms are objects
+    with forward(x) / inverse(y) / forward_log_det_jacobian(x)."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+
+    def sample(self, shape=()):
+        x = _arr(self.base.sample(shape))
+        for t in self.transforms:
+            x = _arr(t.forward(_wrap(x)))
+        return _wrap(x)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        y = _arr(value)
+        ldj = jnp.zeros_like(y, shape=())
+        x = y
+        for t in reversed(self.transforms):
+            x_prev = _arr(t.inverse(_wrap(x)))
+            ldj = ldj + _arr(t.forward_log_det_jacobian(_wrap(x_prev)))
+            x = x_prev
+        return _wrap(_arr(self.base.log_prob(_wrap(x))) - ldj)

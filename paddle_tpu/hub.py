@@ -1,0 +1,58 @@
+"""paddle.hub — load models from a hubconf.py (reference:
+python/paddle/hapi/hub.py).
+
+This environment has no network egress, so only `source="local"` is
+supported: `repo_dir` must be a local directory containing hubconf.py.
+GitHub sources raise a clear error instead of hanging on a download.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source):
+    if source not in ("local",):
+        raise NotImplementedError(
+            f"paddle.hub source={source!r} needs network access; this "
+            f"build supports source='local' with a repo_dir path only")
+
+
+def list(repo_dir, source="local", force_reload=False):
+    """Entrypoint names exported by the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n, v in vars(mod).items()
+            if callable(v) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"hubconf has no entrypoint {model!r}")
+    return fn.__doc__ or ""
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"hubconf has no entrypoint {model!r}")
+    return fn(**kwargs)

@@ -496,3 +496,25 @@ class DataLoader:
             if isinstance(item, BaseException):
                 raise item
             yield item
+
+
+class ComposeDataset(Dataset):
+    """Column-wise composition: sample i is the concatenation of sample i
+    from every dataset (reference: paddle.io.ComposeDataset)."""
+
+    def __init__(self, datasets):
+        assert datasets, "ComposeDataset needs at least one dataset"
+        self.datasets = list(datasets)
+        n = len(self.datasets[0])
+        for d in self.datasets[1:]:
+            assert len(d) == n, "ComposeDataset datasets must align"
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            s = d[idx]
+            out.extend(s if isinstance(s, (tuple, list)) else (s,))
+        return tuple(out)

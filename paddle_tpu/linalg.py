@@ -166,3 +166,36 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
 
 def corrcoef(x, rowvar=True):
     return ops.call("corrcoef_op", _t(x), rowvar=rowvar)
+
+
+# ------------------------------------------------ round-3 API-audit ops
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    from .tensor import Tensor
+    from .tensor_api import _t
+    import jax.numpy as jnp
+    return Tensor._from_array(jnp.linalg.norm(
+        _t(x)._array, ord=p, axis=tuple(axis), keepdims=keepdim))
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False):
+    from .tensor import Tensor
+    from .tensor_api import _t
+    import jax.numpy as jnp
+    arr = _t(x)._array
+    if axis is None:
+        # vector semantics: flatten (jnp.linalg.norm would compute a
+        # MATRIX norm for 2-D input and raise for >=3-D)
+        out = jnp.linalg.norm(arr.reshape(-1), ord=p)
+        if keepdim:
+            out = out.reshape((1,) * arr.ndim)
+        return Tensor._from_array(out)
+    return Tensor._from_array(jnp.linalg.norm(
+        arr, ord=p, axis=axis, keepdims=keepdim))
+
+
+def svdvals(x):
+    from .tensor import Tensor
+    from .tensor_api import _t
+    import jax.numpy as jnp
+    return Tensor._from_array(jnp.linalg.svd(_t(x)._array,
+                                             compute_uv=False))

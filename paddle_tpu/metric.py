@@ -178,3 +178,15 @@ class Auc(Metric):
 
     def name(self):
         return [self._name]
+
+
+def accuracy(input, label, k=1):
+    """Functional top-k accuracy (reference: paddle.metric.accuracy)."""
+    from .tensor_api import _t
+    from .tensor import Tensor
+    import jax.numpy as jnp
+    pred = _t(input)._array
+    lab = _t(label)._array.reshape(-1)
+    topk = jnp.argsort(-pred, axis=-1)[:, :k]
+    hit = (topk == lab[:, None]).any(axis=1)
+    return Tensor._from_array(hit.mean(dtype=jnp.float32))

@@ -41,3 +41,14 @@ from .loss import (  # noqa: F401
 from .clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
 )
+from .extras_r3 import (  # noqa: F401
+    AdaptiveAvgPool1D, AdaptiveMaxPool1D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool3D, AvgPool3D, MaxPool3D, Dropout3D, Maxout, RReLU,
+    ThresholdedReLU, Pad3D, MultiMarginLoss, TripletMarginWithDistanceLoss,
+    HSigmoidLoss, InstanceNorm1D, InstanceNorm3D, Conv1DTranspose,
+    Conv3DTranspose, RNN, RNNCellBase, SpectralNorm, BeamSearchDecoder,
+)
+
+# reference spelling aliases the API audit surfaced
+Silu = SiLU
+MaxUnPool2D = MaxUnpool2D

@@ -1,0 +1,352 @@
+"""Round-3 API-audit layers (reference: python/paddle/nn/layer/*).
+
+Thin Layer wrappers over the functionals added in the same round, plus
+naming aliases the audit surfaced (Silu, MaxUnPool2D, RNN) — each a
+distinct public name in the reference."""
+from __future__ import annotations
+
+import numpy as np
+
+from .layer import Layer
+from . import functional as F
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self.output_size)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True):
+        super().__init__()
+        self._kw = dict(kernel_size=kernel_size, stride=stride,
+                        padding=padding, ceil_mode=ceil_mode,
+                        exclusive=exclusive)
+
+    def forward(self, x):
+        return F.avg_pool3d(x, **self._kw)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, return_mask=False):
+        super().__init__()
+        self._kw = dict(kernel_size=kernel_size, stride=stride,
+                        padding=padding, ceil_mode=ceil_mode)
+
+    def forward(self, x):
+        return F.max_pool3d(x, **self._kw)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.dropout3d(x, self.p, training=self.training)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1):
+        super().__init__()
+        self.groups, self.axis = groups, axis
+
+    def forward(self, x):
+        return F.maxout(x, self.groups, self.axis)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1. / 8., upper=1. / 3.):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        return F.thresholded_relu(x, self.threshold)
+
+
+class Pad3D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW"):
+        super().__init__()
+        self.padding, self.mode, self.value = padding, mode, value
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode=self.mode, value=self.value)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean"):
+        super().__init__()
+        self._kw = dict(p=p, margin=margin, weight=weight,
+                        reduction=reduction)
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, **self._kw)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean"):
+        super().__init__()
+        self.distance_function = distance_function or (
+            lambda a, b: F.pairwise_distance(a, b))
+        self.margin, self.swap, self.reduction = margin, swap, reduction
+
+    def forward(self, input, positive, negative):
+        from .. import tensor_api as T
+        d_pos = self.distance_function(input, positive)
+        d_neg = self.distance_function(input, negative)
+        if self.swap:
+            d_pn = self.distance_function(positive, negative)
+            d_neg = T.minimum(d_neg, d_pn)
+        loss = T.clip(d_pos - d_neg + self.margin, min=0.0)
+        if self.reduction == "mean":
+            return loss.mean()
+        if self.reduction == "sum":
+            return loss.sum()
+        return loss
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False):
+        super().__init__()
+        from ..tensor import parameter
+        from .. import tensor_api as T
+        if is_custom or is_sparse:
+            raise NotImplementedError(
+                "custom-tree / sparse hsigmoid is not supported")
+        self.num_classes = num_classes
+        bound = 1.0 / np.sqrt(feature_size)
+        self.weight = parameter(T.uniform(
+            [num_classes - 1, feature_size], min=-bound, max=bound))
+        self.bias = parameter(T.zeros([num_classes - 1]))
+
+    def forward(self, input, label):
+        return F.hsigmoid_loss(input, label, self.num_classes,
+                               self.weight, self.bias)
+
+
+class InstanceNorm1D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..tensor import parameter
+        from .. import tensor_api as T
+        self.eps = epsilon
+        self.weight = parameter(T.ones([num_features]))
+        self.bias = parameter(T.zeros([num_features]))
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.weight, bias=self.bias,
+                               eps=self.eps)
+
+
+class InstanceNorm3D(InstanceNorm1D):
+    pass
+
+
+class Conv1DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..tensor import parameter
+        from .. import tensor_api as T
+        k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+        bound = 1.0 / np.sqrt(in_channels * k)
+        self.weight = parameter(T.uniform(
+            [in_channels, out_channels // groups, k], min=-bound, max=bound))
+        self.bias = None if bias_attr is False else parameter(
+            T.uniform([out_channels], min=-bound, max=bound))
+        self._kw = dict(stride=stride, padding=padding,
+                        output_padding=output_padding, dilation=dilation,
+                        groups=groups)
+
+    def forward(self, x):
+        return F.conv1d_transpose(x, self.weight, self.bias, **self._kw)
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..tensor import parameter
+        from .. import tensor_api as T
+        k = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        bound = 1.0 / np.sqrt(in_channels * int(np.prod(k)))
+        self.weight = parameter(T.uniform(
+            [in_channels, out_channels // groups, *k], min=-bound,
+            max=bound))
+        self.bias = None if bias_attr is False else parameter(
+            T.uniform([out_channels], min=-bound, max=bound))
+        self._kw = dict(stride=stride, padding=padding,
+                        output_padding=output_padding, dilation=dilation,
+                        groups=groups)
+
+    def forward(self, x):
+        return F.conv3d_transpose(x, self.weight, self.bias, **self._kw)
+
+
+class RNNCellBase(Layer):
+    """Base for user RNN cells driven by nn.RNN (reference:
+    python/paddle/nn/layer/rnn.py RNNCellBase)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None):
+        from .. import tensor_api as T
+        hidden = getattr(self, "hidden_size", None)
+        b = batch_ref.shape[0]
+        return T.zeros([b, hidden], dtype=dtype or "float32")
+
+
+class RNN(Layer):
+    """Run any cell over a sequence (reference: nn.RNN wrapper).
+    cell(input_t, state) -> (output_t, new_state)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .. import tensor_api as T
+        x = inputs
+        if not self.time_major:
+            x = x.transpose([1, 0, 2])           # (T, B, D)
+        steps = range(x.shape[0])
+        if self.is_reverse:
+            steps = reversed(list(steps))
+        state = initial_states
+        if state is None:
+            state = self.cell.get_initial_states(
+                x[0] if not self.time_major else inputs[:, 0])
+        outs = []
+        for t in steps:
+            out, state = self.cell(x[t], state)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        y = T.stack(outs, axis=0)
+        if not self.time_major:
+            y = y.transpose([1, 0, 2])
+        return y, state
+
+
+class SpectralNorm(Layer):
+    """Standalone spectral-norm layer: normalizes a given weight tensor by
+    its largest singular value via power iteration (reference:
+    nn.SpectralNorm; the hook-based variant is nn.utils.spectral_norm)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12):
+        super().__init__()
+        from .. import tensor_api as T
+        self.dim, self.power_iters, self.eps = dim, power_iters, eps
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        self.register_buffer("weight_u", T.randn([h]))
+        self.register_buffer("weight_v", T.randn([w]))
+
+    def forward(self, weight):
+        from .. import tensor_api as T
+        mat = weight.transpose(
+            [self.dim] + [d for d in range(weight.ndim) if d != self.dim])
+        mat2 = mat.reshape([mat.shape[0], -1])
+        u, v = self.weight_u, self.weight_v
+        for _ in range(self.power_iters):
+            v = T.matmul(mat2, u, transpose_x=True)
+            v = v / (v.norm() + self.eps)
+            u = T.matmul(mat2, v)
+            u = u / (u.norm() + self.eps)
+        sigma = (u * T.matmul(mat2, v)).sum()
+        return weight / sigma
+
+
+class BeamSearchDecoder(Layer):
+    """Minimal beam-search decoder over an RNN cell (reference:
+    nn.BeamSearchDecoder + dynamic_decode).  `decode(init_ids, init_state,
+    max_steps)` greedily expands `beam_size` hypotheses with length-
+    normalized log-prob scoring; ancestry is recovered with
+    F.gather_tree."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        super().__init__()
+        self.cell = cell
+        self.start_token, self.end_token = start_token, end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def decode(self, init_state, batch_size, max_steps=32):
+        from .. import tensor_api as T
+        import numpy as np
+        B, K = batch_size, self.beam_size
+        ids = T.full([B, K], self.start_token, dtype="int64")
+        scores = np.zeros((B, K), np.float32)
+        scores[:, 1:] = -1e9                      # only beam 0 is live
+        scores = T.to_tensor(scores)
+        state = init_state
+        all_ids, all_parents = [], []
+        for _ in range(max_steps):
+            tok = ids.reshape([B * K])
+            emb = self.embedding_fn(tok) if self.embedding_fn else \
+                tok.unsqueeze(-1).astype("float32")
+            out, state = self.cell(emb, state)
+            logits = self.output_fn(out) if self.output_fn else out
+            V = logits.shape[-1]
+            logp = F.log_softmax(logits.reshape([B, K, V]), axis=-1)
+            cand = scores.unsqueeze(-1) + logp    # (B, K, V)
+            top_v, top_i = cand.reshape([B, K * V]).topk(K, axis=-1)
+            parents = (top_i // V).astype("int64")
+            ids = (top_i % V).astype("int64")
+            scores = top_v
+            all_ids.append(ids)
+            all_parents.append(parents)
+        stacked_ids = T.stack(all_ids, axis=0)        # (T, B, K)
+        stacked_parents = T.stack(all_parents, axis=0)
+        return F.gather_tree(stacked_ids, stacked_parents), scores

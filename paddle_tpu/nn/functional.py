@@ -731,3 +731,329 @@ def affine_grid(theta, out_shape, align_corners=True):
 
     from ..autograd import engine
     return engine.apply("affine_grid", kernel, [tht])
+
+
+# ------------------------------------------------ round-3 API-audit ops
+def log_sigmoid(x):
+    # -softplus(-x): numerically stable through the registered kernel
+    return -softplus(-_t(x))
+
+
+def thresholded_relu(x, threshold=1.0):
+    x = _t(x)
+    from .. import tensor_api as T
+    return T.where(x > threshold, x, T.zeros_like(x))
+
+
+def rrelu(x, lower=1. / 8., upper=1. / 3., training=True):
+    x = _t(x)
+    from .. import tensor_api as T
+    if training:
+        noise = T.uniform(list(x.shape), min=lower, max=upper)
+        return T.where(x >= 0, x, x * noise)
+    return T.where(x >= 0, x, x * ((lower + upper) / 2.0))
+
+
+def maxout(x, groups, axis=1):
+    x = _t(x)
+    c = x.shape[axis]
+    shape = list(x.shape)
+    shape[axis:axis + 1] = [c // groups, groups]
+    return x.reshape(shape).max(axis=axis + 1)
+
+
+def zeropad2d(x, padding):
+    return pad(_t(x), padding, mode="constant", value=0.0)
+
+
+def dropout3d(x, p=0.5, training=True):
+    """channel-whole dropout on (N, C, D, H, W)."""
+    x = _t(x)
+    if not training or p == 0.0:
+        return x
+    from .. import tensor_api as T
+    keep = (T.uniform([x.shape[0], x.shape[1], 1, 1, 1]) >= p)
+    return x * keep.astype(x.dtype) / (1.0 - p)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False):
+    x4 = _t(x).unsqueeze(2)
+    out = max_pool2d(x4, (1, kernel_size),
+                     None if stride is None else (1, stride),
+                     (0, padding) if isinstance(padding, int) else padding,
+                     ceil_mode=ceil_mode, return_mask=return_mask)
+    if return_mask:
+        return out[0].squeeze(2), out[1].squeeze(2)
+    return out.squeeze(2)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True):
+    x4 = _t(x).unsqueeze(2)
+    out = avg_pool2d(x4, (1, kernel_size),
+                     None if stride is None else (1, stride),
+                     (0, padding) if isinstance(padding, int) else padding,
+                     ceil_mode=ceil_mode, exclusive=exclusive)
+    return out.squeeze(2)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False):
+    return ops.call("max_pool3d", _t(x), kernel_size=kernel_size,
+                    stride=stride, padding=padding, ceil_mode=ceil_mode)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True):
+    return ops.call("avg_pool3d", _t(x), kernel_size=kernel_size,
+                    stride=stride, padding=padding, ceil_mode=ceil_mode,
+                    exclusive=exclusive)
+
+
+def adaptive_avg_pool1d(x, output_size):
+    out = adaptive_avg_pool2d(_t(x).unsqueeze(2), (1, output_size))
+    return out.squeeze(2)
+
+
+def adaptive_max_pool1d(x, output_size):
+    out = adaptive_max_pool2d(_t(x).unsqueeze(2), (1, output_size))
+    return out.squeeze(2)
+
+
+def adaptive_avg_pool3d(x, output_size):
+    """uniform-bin adaptive pool on (N, C, D, H, W)."""
+    x = _t(x)
+    os = (output_size,) * 3 if isinstance(output_size, int) \
+        else tuple(output_size)
+    n, c, d, h, w = x.shape
+    if d % os[0] == 0 and h % os[1] == 0 and w % os[2] == 0:
+        x6 = x.reshape([n, c, os[0], d // os[0], os[1], h // os[1],
+                        os[2], w // os[2]])
+        return x6.mean(axis=7).mean(axis=5).mean(axis=3)
+    raise NotImplementedError(
+        "adaptive_avg_pool3d requires input dims divisible by output_size")
+
+
+def adaptive_max_pool3d(x, output_size):
+    x = _t(x)
+    os = (output_size,) * 3 if isinstance(output_size, int) \
+        else tuple(output_size)
+    n, c, d, h, w = x.shape
+    if d % os[0] == 0 and h % os[1] == 0 and w % os[2] == 0:
+        x6 = x.reshape([n, c, os[0], d // os[0], os[1], h // os[1],
+                        os[2], w // os[2]])
+        return x6.max(axis=7).max(axis=5).max(axis=3)
+    raise NotImplementedError(
+        "adaptive_max_pool3d requires input dims divisible by output_size")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1):
+    x4 = _t(x).unsqueeze(2)                      # (N, C, 1, L)
+    w4 = _t(weight).unsqueeze(2)                 # (I, O, 1, K)
+    out = conv2d_transpose(x4, w4, bias=None, stride=(1, stride),
+                           padding=(0, padding) if isinstance(padding, int)
+                           else padding,
+                           output_padding=(0, output_padding)
+                           if isinstance(output_padding, int)
+                           else output_padding,
+                           dilation=(1, dilation), groups=groups)
+    out = out.squeeze(2)
+    if bias is not None:
+        out = out + bias.reshape([1, -1, 1])
+    return out
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1):
+    out = ops.call("conv3d_transpose", _t(x), _t(weight), stride=stride,
+                   padding=padding, output_padding=output_padding,
+                   dilation=dilation, groups=groups)
+    if bias is not None:
+        out = out + bias.reshape([1, -1, 1, 1, 1])
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5):
+    out = ops.call("instance_norm_op", _t(x), eps=eps)
+    shape = [1, -1] + [1] * (len(out.shape) - 2)
+    if weight is not None:
+        out = out * _t(weight).reshape(shape)
+    if bias is not None:
+        out = out + _t(bias).reshape(shape)
+    return out
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0):
+    return ops.call("local_response_norm_op", _t(x), size=size,
+                    alpha=alpha, beta=beta, k=k)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25):
+    return ops.call("temporal_shift_op", _t(x), seg_num=seg_num,
+                    shift_ratio=shift_ratio)
+
+
+def gather_tree(ids, parents):
+    return ops.call("gather_tree_op", _t(ids), _t(parents))
+
+
+def bilinear(x1, x2, weight, bias=None):
+    """out[b, o] = x1[b, i] W[o, i, j] x2[b, j]  (+ bias)."""
+    x1, x2, weight = _t(x1), _t(x2), _t(weight)
+    from ..autograd import engine
+    out = engine.apply(
+        "bilinear", lambda a, b, w: jnp.einsum("bi,oij,bj->bo", a, w, b),
+        [x1, x2, weight])
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+# ----------------------------------------------------- round-3 losses
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def square_error_cost(input, label):
+    d = _t(input) - _t(label)
+    return d * d
+
+
+def log_loss(input, label, epsilon=1e-4):
+    from .. import tensor_api as T
+    p = _t(input)
+    y = _t(label)
+    return -y * T.log(p + epsilon) - (1.0 - y) * T.log(1.0 - p + epsilon)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean"):
+    from .. import tensor_api as T
+    cos = cosine_similarity(_t(input1), _t(input2), axis=1)
+    label = _t(label).astype(cos.dtype)
+    pos = 1.0 - cos
+    neg = T.clip(cos - margin, min=0.0)
+    loss = T.where(label > 0, pos, neg)
+    return _reduce_loss(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    from .. import tensor_api as T
+    loss = T.clip(-_t(label) * (_t(input) - _t(other)) + margin, min=0.0)
+    return _reduce_loss(loss, reduction)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean"):
+    from .. import tensor_api as T
+    x = _t(input)
+    n, c = x.shape
+    lab = _t(label).astype("int32")
+    x_y = T.take_along_axis(x, lab.unsqueeze(1), axis=1)   # (N, 1)
+    m = T.clip(margin - x_y + x, min=0.0)
+    if p != 1:
+        m = m ** p
+    if weight is not None:
+        m = m * T.take_along_axis(_t(weight).unsqueeze(0).expand([n, c]),
+                                  lab.unsqueeze(1), axis=1)
+    # exclude the true class from the sum
+    onehot = one_hot(lab, c).astype(x.dtype)
+    loss = (m * (1.0 - onehot)).sum(axis=1) / float(c)
+    return _reduce_loss(loss, reduction)
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """input (N, ..., C) probabilities, label (N, ..., 1) class ids."""
+    x = _t(input)
+    lab = _t(label)
+    n_cls = x.shape[-1]
+    onehot = one_hot(lab.squeeze(-1), n_cls).astype(x.dtype)
+    x2 = x.reshape([x.shape[0], -1])
+    y2 = onehot.reshape([onehot.shape[0], -1])
+    inter = (x2 * y2).sum(axis=1)
+    union = x2.sum(axis=1) + y2.sum(axis=1)
+    return (1.0 - (2.0 * inter + epsilon) / (union + epsilon)).mean()
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    from .. import tensor_api as T
+    a, p = _t(anchor), _t(positive)
+    lab = _t(labels).reshape([-1, 1])
+    sim = T.matmul(a, p, transpose_y=True)       # (N, N)
+    tgt = (lab == lab.reshape([1, -1])).astype(sim.dtype)
+    tgt = tgt / tgt.sum(axis=1, keepdim=True)
+    ce = softmax_with_cross_entropy(sim, tgt, soft_label=True)
+    reg = (a * a).sum(axis=1).mean() + (p * p).sum(axis=1).mean()
+    return ce.mean() + l2_reg * reg * 0.25
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction="sum"):
+    from .. import tensor_api as T
+    x, y = _t(logit), _t(label).astype(_t(logit).dtype)
+    p = sigmoid(x)
+    ce = binary_cross_entropy_with_logits(x, y, reduction="none")
+    p_t = p * y + (1.0 - p) * (1.0 - y)
+    a_t = alpha * y + (1.0 - alpha) * (1.0 - y)
+    loss = a_t * ((1.0 - p_t) ** gamma) * ce
+    if normalizer is not None:
+        loss = loss / _t(normalizer)
+    return _reduce_loss(loss, reduction)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None):
+    """Complete-binary-tree hierarchical sigmoid loss (reference:
+    python/paddle/nn/functional/loss.py hsigmoid_loss, default tree)."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "custom-tree hsigmoid (path_table/path_code) is not supported; "
+            "use the default complete binary tree")
+    from .. import tensor_api as T
+    x = _t(input)                                 # (N, D)
+    lab = np.asarray(_t(label)._array).reshape(-1)
+    depth = max(1, int(np.ceil(np.log2(max(num_classes, 2)))))
+    codes = np.zeros((lab.shape[0], depth), np.int32)
+    signs = np.zeros((lab.shape[0], depth), np.float32)
+    for i, c in enumerate(lab):                  # host-side path build
+        node = int(c) + num_classes - 1          # leaf id in the full tree
+        for d in range(depth - 1, -1, -1):
+            parent = (node - 1) // 2
+            signs[i, d] = 1.0 if node == 2 * parent + 1 else 0.0
+            codes[i, d] = parent
+            node = parent
+    # shallow leaves reach the root before `depth` steps (non-power-of-2
+    # num_classes): mask those levels out instead of walking past the root
+    valid = codes >= 0
+    codes = np.maximum(codes, 0)
+    w = _t(weight)                               # (num_classes-1, D)
+    wt = T.to_tensor(codes.reshape(-1))
+    w_sel = w[wt].reshape([lab.shape[0], depth, -1])
+    logits = (w_sel * x.unsqueeze(1)).sum(axis=2)
+    if bias is not None:
+        b_sel = _t(bias).reshape([-1])[wt].reshape([lab.shape[0], depth])
+        logits = logits + b_sel
+    sg = T.to_tensor(signs)
+    per_level = binary_cross_entropy_with_logits(logits, sg,
+                                                 reduction="none")
+    per_level = per_level * T.to_tensor(valid.astype(np.float32))
+    return per_level.sum(axis=1, keepdim=True)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean"):
+    from .. import tensor_api as T
+    dfn = distance_function or (lambda a, b: pairwise_distance(a, b))
+    d_pos = dfn(_t(input), _t(positive))
+    d_neg = dfn(_t(input), _t(negative))
+    if swap:
+        d_neg = T.minimum(d_neg, dfn(_t(positive), _t(negative)))
+    loss = T.clip(d_pos - d_neg + margin, min=0.0)
+    return _reduce_loss(loss, reduction)

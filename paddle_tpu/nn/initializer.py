@@ -176,3 +176,40 @@ def calculate_gain(nonlinearity, param=None):
     if nonlinearity == "selu":
         return 3.0 / 4
     return 1.0
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transpose convs (reference:
+    nn.initializer.Bilinear)."""
+
+    def __call__(self, t):
+        import numpy as np
+        shape = t.shape
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects 4-D weights")
+        kh, kw = shape[2], shape[3]
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        ch = (2 * fh - 1 - fh % 2) / (2.0 * fh)
+        cw = (2 * fw - 1 - fw % 2) / (2.0 * fw)
+        og = np.ogrid[:kh, :kw]
+        filt = (1 - abs(og[0] / fh - ch)) * (1 - abs(og[1] / fw - cw))
+        w = np.zeros(shape, np.float32)
+        for i in range(shape[0]):
+            for j in range(shape[1]):
+                w[i, j] = filt
+        from ..tensor import Tensor
+        import jax.numpy as jnp
+        t._inplace_assign(jnp.asarray(w, t._array.dtype))
+        return t
+
+
+_GLOBAL_INIT = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Record process-wide default initializers (reference:
+    nn.initializer.set_global_initializer).  Layers constructed AFTER this
+    call apply them via ParamAttr defaults where supported; passing None
+    clears."""
+    _GLOBAL_INIT["weight"] = weight_init
+    _GLOBAL_INIT["bias"] = bias_init

@@ -487,3 +487,69 @@ register("polar", lambda r, t: (r * jnp.cos(t)
 register("angle", jnp.angle)
 register("deg2rad", jnp.deg2rad)
 register("rad2deg", jnp.rad2deg)
+
+# ---------------------------------------------- round-3 API-audit kernels
+register("as_complex", lambda x: (x[..., 0] + 1j * x[..., 1]).astype(
+    jnp.complex64))
+register("as_real", lambda x: jnp.stack(
+    [jnp.real(x), jnp.imag(x)], axis=-1).astype(jnp.float32))
+register("block_diag_op", lambda *xs: jax.scipy.linalg.block_diag(*xs),
+         amp="allow")
+register("column_stack", lambda *xs: jnp.column_stack(xs))
+register("hstack_op", lambda *xs: jnp.hstack(xs))
+register("vstack_op", lambda *xs: jnp.vstack(xs))
+register("dstack_op", lambda *xs: jnp.dstack(xs))
+register("diagflat", lambda x, offset=0: jnp.diagflat(x, k=offset))
+register("inner_op", lambda x, y: jnp.inner(x, y), amp="allow")
+register("kron", lambda x, y: jnp.kron(x, y), amp="allow")
+register("logit_op", lambda x, eps: jnp.log(x / (1.0 - x)) if eps is None
+         else jnp.log(jnp.clip(x, eps, 1.0 - eps)
+                      / (1.0 - jnp.clip(x, eps, 1.0 - eps))))
+register("nanmedian_op", lambda x, axis=None, keepdim=False:
+         jnp.nanmedian(x, axis=axis, keepdims=keepdim))
+register("polygamma_op",
+         lambda x, n: jax.scipy.special.polygamma(n, x))
+register("sgn", lambda x: jnp.where(
+    jnp.abs(x) == 0, jnp.zeros_like(x), x / jnp.abs(x))
+    if jnp.iscomplexobj(x) else jnp.sign(x))
+register("stanh", lambda x, scale_a=0.67, scale_b=1.7159:
+         scale_b * jnp.tanh(scale_a * x))
+register("index_sample", lambda x, index: jnp.take_along_axis(
+    x, index.astype(jnp.int32), axis=1))
+register("scatter_nd_op", lambda index, updates, shape:
+         jnp.zeros(shape, updates.dtype).at[
+             tuple(jnp.moveaxis(index.astype(jnp.int32), -1, 0))
+         ].add(updates))
+register("index_put_op", lambda x, value, *idx, accumulate=False:
+         (x.at[tuple(i.astype(jnp.int32) if jnp.issubdtype(
+             i.dtype, jnp.integer) else i for i in idx)].add(value))
+         if accumulate else
+         (x.at[tuple(i.astype(jnp.int32) if jnp.issubdtype(
+             i.dtype, jnp.integer) else i for i in idx)].set(value)))
+
+
+def _cummax_k(x, axis, mode):
+    op = lax.cummax if mode == "max" else lax.cummin
+    vals = op(x, axis=axis)
+    iota = lax.broadcasted_iota(jnp.int32, x.shape, axis)
+    # index of the LATEST element equal to the running extremum
+    idx = lax.cummax(jnp.where(x == vals, iota, -1), axis=axis)
+    return vals, idx
+
+
+register("cummax_op", lambda x, axis: _cummax_k(x, axis, "max"))
+register("cummin_op", lambda x, axis: _cummax_k(x, axis, "min"))
+
+
+def _unfold_k(x, axis, size, step):
+    n = (x.shape[axis] - size) // step + 1
+    starts = jnp.arange(n) * step
+    idx = starts[:, None] + jnp.arange(size)[None, :]   # (n, size)
+    out = jnp.take(x, idx.reshape(-1), axis=axis)
+    out = jnp.moveaxis(out, axis, -1)
+    out = out.reshape(out.shape[:-1] + (n, size))
+    # paddle layout: windows appended as the LAST axis, window dim last
+    return jnp.moveaxis(out, -2, axis)
+
+
+register("unfold_tensor", _unfold_k)

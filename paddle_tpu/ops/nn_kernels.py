@@ -505,3 +505,126 @@ def max_unpool2d_k(x, indices, out_h, out_w):
     # the same value and must not double it
     flat = flat.at[b, c, indices.astype(jnp.int32)].set(x)
     return flat.reshape(N, C, out_h, out_w)
+
+
+# ---------------------------------------------- round-3 API-audit kernels
+def _tri(v):
+    return (int(v),) * 3 if isinstance(v, int) else tuple(v)
+
+
+@register("max_pool3d")
+def max_pool3d_k(x, kernel_size, stride=None, padding=0, ceil_mode=False):
+    k = _tri(kernel_size)
+    s = _tri(stride if stride is not None else kernel_size)
+    p = _conv_padding(padding, 3)
+    if ceil_mode:
+        p = [(p[i][0], p[i][1] + _ceil_extra(x.shape[2 + i], k[i], s[i],
+                                             p[i])) for i in range(3)]
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+        jnp.iinfo(x.dtype).min
+    return lax.reduce_window(
+        x, init, lax.max, (1, 1) + k, (1, 1) + s,
+        [(0, 0), (0, 0)] + list(p))
+
+
+@register("avg_pool3d")
+def avg_pool3d_k(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True):
+    k = _tri(kernel_size)
+    s = _tri(stride if stride is not None else kernel_size)
+    p = _conv_padding(padding, 3)
+    if ceil_mode:
+        p = [(p[i][0], p[i][1] + _ceil_extra(x.shape[2 + i], k[i], s[i],
+                                             p[i])) for i in range(3)]
+    win, strides = (1, 1) + k, (1, 1) + s
+    pads = [(0, 0), (0, 0)] + list(p)
+    summed = lax.reduce_window(x, 0.0, lax.add, win, strides, pads)
+    if exclusive and any(pi != (0, 0) for pi in p):
+        counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, win,
+                                   strides, pads)
+        return summed / jnp.maximum(counts, 1.0)
+    return summed / (k[0] * k[1] * k[2])
+
+
+@register("conv3d_transpose", amp="allow")
+def conv3d_transpose_k(x, w, stride=1, padding=0, output_padding=0,
+                       dilation=1, groups=1):
+    s = _tri(stride)
+    p = _conv_padding(padding, 3)
+    if isinstance(p, str):
+        raise ValueError("string padding unsupported for transpose conv")
+    k = w.shape[2:]
+    op = _tri(output_padding)
+    d = _tri(dilation)
+    pads = [(d[i] * (k[i] - 1) - p[i][0],
+             d[i] * (k[i] - 1) - p[i][1] + op[i]) for i in range(3)]
+    if groups > 1:
+        xs = jnp.split(x, groups, axis=1)
+        ws = jnp.split(w, groups, axis=0)
+        outs = [conv3d_transpose_k(xi, wi, stride, padding, output_padding,
+                                   dilation, 1) for xi, wi in zip(xs, ws)]
+        return jnp.concatenate(outs, axis=1)
+    w_t = jnp.flip(w, axis=(2, 3, 4)).swapaxes(0, 1)
+    return lax.conv_general_dilated(
+        x, w_t, window_strides=(1, 1, 1), padding=pads,
+        lhs_dilation=s, rhs_dilation=d,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+
+
+@register("instance_norm_op")
+def instance_norm_k(x, weight=None, bias=None, eps=1e-5):
+    axes = tuple(range(2, x.ndim))
+    mean = x.mean(axis=axes, keepdims=True)
+    var = x.var(axis=axes, keepdims=True)
+    out = (x - mean) / jnp.sqrt(var + eps)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register("local_response_norm_op")
+def local_response_norm_k(x, size, alpha=1e-4, beta=0.75, k=1.0):
+    sq = jnp.square(x)
+    half = size // 2
+    pads = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (x.ndim - 2)
+    acc = lax.reduce_window(sq, 0.0, lax.add,
+                            (1, size) + (1,) * (x.ndim - 2),
+                            (1,) * x.ndim, pads)
+    return x / jnp.power(k + alpha * acc / size, beta)
+
+
+@register("temporal_shift_op")
+def temporal_shift_k(x, seg_num, shift_ratio=0.25):
+    # (N*T, C, H, W) -> shift 1/4 channels backward, 1/4 forward in time
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x5 = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    back = jnp.concatenate([x5[:, 1:, :fold], jnp.zeros_like(
+        x5[:, :1, :fold])], axis=1)
+    fwd = jnp.concatenate([jnp.zeros_like(x5[:, :1, fold:2 * fold]),
+                           x5[:, :-1, fold:2 * fold]], axis=1)
+    rest = x5[:, :, 2 * fold:]
+    return jnp.concatenate([back, fwd, rest], axis=2).reshape(nt, c, h, w)
+
+
+@register("gather_tree_op")
+def gather_tree_k(ids, parents):
+    """(T, B, beam) beam-search ancestry walk (reference: fluid gather_tree
+    → paddle.nn.functional.gather_tree)."""
+    T = ids.shape[0]
+
+    def body(carry, xs):
+        beam_idx = carry                     # (B, beam)
+        step_ids, step_parents = xs
+        out = jnp.take_along_axis(step_ids, beam_idx, axis=1)
+        nxt = jnp.take_along_axis(step_parents, beam_idx, axis=1)
+        return nxt, out
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2])[None, :],
+                            ids.shape[1:])
+    _, out = lax.scan(body, init, (ids[::-1], parents[::-1]))
+    return out[::-1]

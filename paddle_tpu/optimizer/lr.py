@@ -317,3 +317,24 @@ class CyclicLR(LRScheduler):
         else:
             raise ValueError(f"unknown CyclicLR mode {self.mode!r}")
         return self.base_lr + (self.max_lr - self.base_lr) * x * scale
+
+
+class MultiplicativeDecay(LRScheduler):
+    """lr_{t} = lr_{t-1} * lr_lambda(t) (reference:
+    paddle.optimizer.lr.MultiplicativeDecay)."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
+                 verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        lr = self.base_lr
+        for e in range(1, self.last_epoch + 1):
+            lr = lr * self.lr_lambda(e)
+        return lr
+
+    def state_dict(self):
+        d = super().state_dict()
+        d.pop("lr_lambda", None)
+        return d

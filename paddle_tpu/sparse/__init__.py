@@ -30,9 +30,13 @@ def _arr(x):
 class SparseCooTensor:
     """COO sparse tensor (reference: paddle's sparse_coo place tensors)."""
 
-    def __init__(self, bcoo, coalesced=False):
+    def __init__(self, bcoo, coalesced=False, values_t=None):
         self._bcoo = bcoo
         self._coalesced = coalesced
+        # optional tape-connected values Tensor (round 3): lets gradients
+        # flow through ops that produced this sparse tensor (sparse.nn
+        # convs) when the values are later densified/read
+        self._values_t = values_t
 
     # ------------------------------------------------------------- factory
     @staticmethod
@@ -53,12 +57,23 @@ class SparseCooTensor:
         return Tensor._from_array(self._bcoo.indices.T)
 
     def values(self):
+        if self._values_t is not None:
+            return self._values_t
         return Tensor._from_array(self._bcoo.data)
 
     def nnz(self):
         return int(self._bcoo.nse)
 
     def to_dense(self):
+        if self._values_t is not None:
+            from ..autograd import engine
+            idx = self._bcoo.indices
+            shape = tuple(self._bcoo.shape)
+            return engine.apply(
+                "sparse_to_dense",
+                lambda v: jnp.zeros(shape, v.dtype).at[
+                    tuple(idx.T)].add(v),
+                [self._values_t])
         return Tensor._from_array(self._bcoo.todense())
 
     def coalesce(self):
@@ -222,3 +237,13 @@ def to_dense(x):
 
 def nnz(x):
     return x.nnz()
+
+
+def abs(x):
+    """Elementwise |x| on the sparse values (pattern-preserving)."""
+    b = _coo(x)
+    return SparseCooTensor(jsparse.BCOO((jnp.abs(b.data), b.indices),
+                                        shape=b.shape))
+
+
+from . import nn  # noqa: F401,E402

@@ -1,0 +1,149 @@
+"""paddle.sparse.nn (reference: python/paddle/sparse/nn — sparse conv /
+BN / activation layers for point-cloud workloads).
+
+Correctness-first TPU backing: Conv3D/SubmConv3D compute through the
+dense XLA conv on the densified input and re-sparsify the result (output
+pattern from the occupancy mask; submanifold keeps the input pattern) —
+exactly the dense-masking semantics the reference kernels implement with
+gather/scatter.  This keeps forward+grad parity on TPU; a gather-based
+pallas path for large point clouds is future work, documented in
+docs/api_coverage.md.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from ..tensor import Tensor, parameter
+from .. import tensor_api as T
+from . import SparseCooTensor, _coo
+from jax.experimental import sparse as jsparse
+
+
+def _sparsify_like_mask(dense, occupancy):
+    """BCOO from `dense` keeping entries where occupancy (bool) is True."""
+    idx = jnp.stack(jnp.nonzero(occupancy), axis=1)
+    vals = dense[tuple(idx.T)]
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=dense.shape))
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from . import relu as _sp_relu
+        return _sp_relu(x)
+
+
+class BatchNorm(Layer):
+    """Channel-last BN over the NON-ZERO values of an (N, D, H, W, C)
+    sparse tensor (reference: paddle.sparse.nn.BatchNorm)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        self.eps = epsilon
+        self.momentum = momentum
+        self.weight = parameter(T.ones([num_features]))
+        self.bias = parameter(T.zeros([num_features]))
+        self.register_buffer("_mean", T.zeros([num_features]))
+        self.register_buffer("_variance", T.ones([num_features]))
+
+    def forward(self, x):
+        import jax
+        b = _coo(x)
+        vals = b.data                     # (nnz,) scalar entries
+        C = b.shape[-1]
+        ch = b.indices[:, -1]             # channel id per non-zero
+        if self.training:
+            counts = jnp.maximum(
+                jax.ops.segment_sum(jnp.ones_like(vals), ch, C), 1.0)
+            mean = jax.ops.segment_sum(vals, ch, C) / counts
+            var = jax.ops.segment_sum(
+                (vals - mean[ch]) ** 2, ch, C) / counts
+            m = self.momentum
+            self._mean._inplace_assign(m * self._mean._array
+                                       + (1 - m) * mean)
+            self._variance._inplace_assign(m * self._variance._array
+                                           + (1 - m) * var)
+        else:
+            mean, var = self._mean._array, self._variance._array
+        out = (vals - mean[ch]) / jnp.sqrt(var[ch] + self.eps)
+        out = out * self.weight._array[ch] + self.bias._array[ch]
+        return SparseCooTensor(jsparse.BCOO((out, b.indices),
+                                            shape=b.shape))
+
+
+class Conv3D(Layer):
+    """Sparse 3-D conv on (N, D, H, W, C) COO input; output pattern is the
+    conv-dilated occupancy (reference: paddle.sparse.nn.Conv3D)."""
+
+    _subm = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__()
+        k = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        bound = 1.0 / np.sqrt(in_channels * int(np.prod(k)))
+        # reference weight layout: (kd, kh, kw, in/groups, out)
+        self.weight = parameter(T.uniform(
+            [*k, in_channels // groups, out_channels],
+            min=-bound, max=bound))
+        self.bias = None if bias_attr is False else parameter(
+            T.zeros([out_channels]))
+        self.stride = (stride,) * 3 if isinstance(stride, int) \
+            else tuple(stride)
+        self.padding = padding
+        self.dilation = (dilation,) * 3 if isinstance(dilation, int) \
+            else tuple(dilation)
+        self.groups = groups
+
+    def forward(self, x):
+        from ..ops import dispatch as ops
+        from ..autograd import engine
+        dense = _coo(x).todense()
+
+        def conv_fn(xa, wa, ba=None):
+            xt = jnp.moveaxis(xa, -1, 1)
+            wt = jnp.transpose(wa, (4, 3, 0, 1, 2))
+            o = ops.call_raw("conv3d", xt, wt, stride=self.stride,
+                             padding=self.padding, dilation=self.dilation,
+                             groups=self.groups)
+            if ba is not None:
+                o = o + ba.reshape([1, -1, 1, 1, 1])
+            return jnp.moveaxis(o, 1, -1)
+
+        ins = [Tensor._from_array(dense), self.weight]
+        if self.bias is not None:
+            ins.append(self.bias)
+        out = engine.apply("sparse_conv3d", conv_fn, ins)
+
+        occ = (jnp.abs(dense).sum(axis=-1) > 0).astype(jnp.float32)
+        occ_out = conv_fn(occ[..., None],
+                          jnp.ones(self.weight._array.shape[:3] + (1, 1),
+                                   jnp.float32))
+        if self._subm:
+            mask = (jnp.abs(dense).sum(axis=-1, keepdims=True) > 0)
+        else:
+            mask = occ_out > 0
+        mask = jnp.broadcast_to(mask, out.shape)
+        # stay in tape-recorded Tensor ops: wrapping raw arrays here would
+        # sever the weight's grad chain
+        masked = out * Tensor._from_array(mask.astype(out._array.dtype))
+        idx = jnp.stack(jnp.nonzero(mask), axis=1)
+        vals = masked[tuple(Tensor._from_array(idx[:, i])
+                            for i in range(idx.shape[1]))]
+        return SparseCooTensor(jsparse.BCOO(
+            (vals._array, idx), shape=tuple(out.shape)), values_t=vals)
+
+
+class SubmConv3D(Conv3D):
+    """Submanifold sparse conv: output non-zero pattern == input pattern
+    (reference: paddle.sparse.nn.SubmConv3D)."""
+
+    _subm = True
+
+    def __init__(self, *args, **kwargs):
+        kwargs.setdefault("padding", 1)
+        super().__init__(*args, **kwargs)

@@ -646,7 +646,9 @@ def as_strided(x, shape, stride, offset=0):
     for k, (s, st) in enumerate(zip(shape, stride)):
         ax_idx = jnp.arange(s, dtype=jnp.int32) * builtins.int(st)
         expand = [None] * len(shape)
-        expand[k] = slice(None)
+        # NB: builtins.slice — the module-level paddle `slice` op (round-3
+        # API audit) shadows the builtin inside this module
+        expand[k] = builtins.slice(None)
         idx = idx + ax_idx[tuple(expand)]
     return Tensor._from_array(jnp.take(flat, idx))
 
@@ -822,3 +824,229 @@ def deg2rad(x):
 
 def rad2deg(x):
     return ops.call("rad2deg", _t(x))
+
+
+# ------------------------------------------------ round-3 API-audit ops
+def cat(x, axis=0):
+    return concat(x, axis=axis)
+
+
+def t(x):
+    x = _t(x)
+    if x.ndim > 2:
+        raise ValueError("paddle.t expects a 0/1/2-D tensor; use transpose")
+    return x if x.ndim < 2 else transpose(x, [1, 0])
+
+
+def tolist(x):
+    return np.asarray(_t(x)._array).tolist()
+
+
+def add_n(inputs):
+    if isinstance(inputs, Tensor):
+        return inputs
+    out = inputs[0]
+    for v in inputs[1:]:
+        out = out + v
+    return out
+
+
+def as_complex(x):
+    return ops.call("as_complex", _t(x))
+
+
+def as_real(x):
+    return ops.call("as_real", _t(x))
+
+
+def block_diag(inputs):
+    return ops.call("block_diag_op", *[_t(v) for v in inputs])
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def column_stack(x):
+    return ops.call("column_stack", *[_t(v) for v in x])
+
+
+def hstack(x):
+    return ops.call("hstack_op", *[_t(v) for v in x])
+
+
+def vstack(x):
+    return ops.call("vstack_op", *[_t(v) for v in x])
+
+
+def dstack(x):
+    return ops.call("dstack_op", *[_t(v) for v in x])
+
+
+def tensor_split(x, num_or_indices, axis=0):
+    x = _t(x)
+    arrs = jnp.array_split(x._array, num_or_indices
+                           if isinstance(num_or_indices, builtins.int)
+                           else list(num_or_indices), axis=axis)
+    return [Tensor._from_array(a) for a in arrs]
+
+
+def hsplit(x, num_or_indices):
+    return tensor_split(x, num_or_indices, axis=1 if _t(x).ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def cummax(x, axis=None, dtype="int64"):
+    x = _t(x)
+    if axis is None:
+        x = x.reshape([-1])
+        axis = 0
+    return ops.call("cummax_op", x, axis=axis)
+
+
+def cummin(x, axis=None, dtype="int64"):
+    x = _t(x)
+    if axis is None:
+        x = x.reshape([-1])
+        axis = 0
+    return ops.call("cummin_op", x, axis=axis)
+
+
+def diagflat(x, offset=0):
+    return ops.call("diagflat", _t(x), offset=offset)
+
+
+def dist(x, y, p=2):
+    return (_t(x) - _t(y)).norm(p=p)
+
+
+def floor_mod(x, y):
+    return mod(x, y)
+
+
+def index_put(x, indices, value, accumulate=False):
+    return ops.call("index_put_op", _t(x), _t(value),
+                    *[_t(i) for i in indices], accumulate=accumulate)
+
+
+def index_sample(x, index):
+    return ops.call("index_sample", _t(x), _t(index))
+
+
+def inner(x, y):
+    return ops.call("inner_op", _t(x), _t(y))
+
+
+def is_complex(x):
+    return jnp.issubdtype(_t(x)._array.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(_t(x)._array.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(_t(x)._array.dtype, jnp.integer)
+
+
+def is_empty(x):
+    return Tensor._from_array(jnp.asarray(_t(x)._array.size == 0))
+
+
+def kron(x, y):
+    return ops.call("kron", _t(x), _t(y))
+
+
+def logit(x, eps=None):
+    return ops.call("logit_op", _t(x), eps=eps)
+
+
+def multiplex(inputs, index):
+    stacked = stack(inputs, axis=0)             # (K, B, ...)
+    idx = _t(index).reshape([-1]).astype("int32")
+    rows = Tensor._from_array(jnp.arange(idx.shape[0]))
+    return stacked[idx, rows]
+
+
+def mv(x, vec):
+    return matmul(x, vec)
+
+
+def nanmedian(x, axis=None, keepdim=False):
+    return ops.call("nanmedian_op", _t(x), axis=axis, keepdim=keepdim)
+
+
+def polygamma(x, n):
+    return ops.call("polygamma_op", _t(x), n=builtins.int(n))
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    x = _t(x)
+    return randint(low, high, list(x.shape),
+                   dtype=dtype or str(x.dtype))
+
+
+def scatter_nd(index, updates, shape):
+    return ops.call("scatter_nd_op", _t(index), _t(updates),
+                    shape=tuple(shape))
+
+
+def sgn(x):
+    return ops.call("sgn", _t(x))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    x = _t(input)
+    size = (index_num + nshards - 1) // nshards
+    arr = x._array
+    in_shard = (arr // size) == shard_id
+    return Tensor._from_array(
+        jnp.where(in_shard, arr % size, ignore_value).astype(arr.dtype))
+
+
+def slice(input, axes, starts, ends):
+    x = _t(input)
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[ax] = builtins.slice(builtins.int(s), builtins.int(e))
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    x = _t(x)
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(builtins.int(s), builtins.int(e),
+                                 builtins.int(st))
+    return x[tuple(idx)]
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return ops.call("stanh", _t(x), scale_a=scale_a, scale_b=scale_b)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return Tensor._from_array(jnp.stack([r, c]).astype(jnp.int32))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return Tensor._from_array(jnp.stack([r, c]).astype(jnp.int32))
+
+
+def unfold(x, axis, size, step):
+    return ops.call("unfold_tensor", _t(x), axis=axis, size=size, step=step)
+
+
+def unstack(x, axis=0, num=None):
+    return unbind(x, axis=axis)

@@ -106,3 +106,41 @@ def run_check():
     return True
 
 from . import cpp_extension  # noqa: F401,E402
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (reference: paddle.utils.
+    deprecated): emits a DeprecationWarning at call time."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API '{fn.__qualname__}' is deprecated since {since}"
+            if update_to:
+                msg += f"; use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version against a range (reference:
+    paddle.utils.require_version)."""
+    from .. import version as _v
+
+    def _tup(v):
+        return tuple(int(p) for p in str(v).split(".")[:3])
+
+    cur = _tup(getattr(_v, "full_version", "0.1.0"))
+    if _tup(min_version) > cur:
+        raise Exception(
+            f"installed version {cur} < required minimum {min_version}")
+    if max_version is not None and _tup(max_version) < cur:
+        raise Exception(
+            f"installed version {cur} > required maximum {max_version}")
+    return True

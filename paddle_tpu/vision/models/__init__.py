@@ -3,7 +3,7 @@ from .resnet import (  # noqa: F401
     resnet101, resnet152, wide_resnet50_2, resnext50_32x4d,
 )
 from .lenet import LeNet  # noqa: F401
-from .vgg import VGG, vgg16, vgg19  # noqa: F401
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenetv1 import MobileNetV1, mobilenet_v1  # noqa: F401
 from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
 from .mobilenetv3 import (  # noqa: F401

@@ -2,6 +2,9 @@
 from ... import nn
 
 _CFGS = {
+    11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    13: [64, 64, "M", 128, 128, "M", 256, 256, "M",
+         512, 512, "M", 512, 512, "M"],
     16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
          512, 512, 512, "M", 512, 512, 512, "M"],
     19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
@@ -49,3 +52,11 @@ def vgg16(pretrained=False, batch_norm=False, **kwargs):
 
 def vgg19(pretrained=False, batch_norm=False, **kwargs):
     return VGG(_make_features(_CFGS[19], batch_norm), **kwargs)
+
+
+def vgg11(pretrained=False, batch_norm=False, **kwargs):
+    return VGG(_make_features(_CFGS[11], batch_norm), **kwargs)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kwargs):
+    return VGG(_make_features(_CFGS[13], batch_norm), **kwargs)

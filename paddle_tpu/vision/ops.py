@@ -9,6 +9,7 @@ bilinear gather over sampling points.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -389,3 +390,197 @@ class DeformConv2D(_Layer):
         st, pd, dl, dg, g = self._cfg
         return deform_conv2d(x, offset, self.weight, self.bias,
                              st, pd, dl, dg, g, mask)
+
+
+# ------------------------------------------------ round-3 API-audit adds
+def _make_layer_base():
+    from ..nn.layer import Layer
+    return Layer
+
+
+class RoIAlign(_make_layer_base()):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool(_make_layer_base()):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Position-sensitive RoI pooling (reference: vision/ops.py
+    psroi_pool): input channels C = out_c * ph * pw; bin (i, j) of output
+    channel k average-pools input channel k*ph*pw + i*pw + j."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    x = _t(x)
+    N, C, H, W = x.shape
+    out_c = C // (ph * pw)
+    # average RoI pooling per channel via roi_align with 1 sample per bin
+    pooled = roi_align(x, boxes, boxes_num, output_size, spatial_scale,
+                       sampling_ratio=1, aligned=False)  # (R, C, ph, pw)
+    R = pooled.shape[0]
+    p5 = pooled.reshape([R, out_c, ph, pw, ph, pw])
+    # select the position-sensitive diagonal: channel group (i, j) at
+    # output bin (i, j)
+    import jax.numpy as jnp
+    from ..tensor import Tensor
+    arr = p5._array
+    ii = jnp.arange(ph)
+    jj = jnp.arange(pw)
+    sel = arr[:, :, ii[:, None], jj[None, :], ii[:, None], jj[None, :]]
+    return Tensor._from_array(sel)
+
+
+class PSRoIPool(_make_layer_base()):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None):
+    """Assign each RoI to an FPN level by scale (reference: vision/ops.py
+    distribute_fpn_proposals).  Eager (data-dependent sizes)."""
+    import numpy as np
+    rois = np.asarray(_t(fpn_rois)._array)
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = np.sqrt(np.maximum(w * h, 1e-12))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    from ..tensor import Tensor
+    import jax.numpy as jnp
+    multi_rois, restore = [], np.zeros(len(rois), np.int64)
+    order = []
+    for L in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == L)[0]
+        order.extend(idx.tolist())
+        multi_rois.append(Tensor._from_array(jnp.asarray(rois[idx])))
+    restore[np.asarray(order, np.int64)] = np.arange(len(rois))
+    nums = [Tensor._from_array(jnp.asarray([r.shape[0]], jnp.int32))
+            for r in multi_rois]
+    return multi_rois, Tensor._from_array(jnp.asarray(restore)), nums
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head predictions to boxes+scores (reference:
+    vision/ops.py yolo_box)."""
+    import jax.numpy as jnp
+    from ..tensor import Tensor
+    if iou_aware:
+        raise NotImplementedError(
+            "yolo_box(iou_aware=True) heads (C = na*(6+classes)) are not "
+            "supported; decode with iou_aware=False layouts")
+    xa = _t(x)._array
+    N, C, H, W = xa.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    pred = xa.reshape(N, na, 5 + class_num, H, W)
+    gx = (jnp.arange(W)[None, None, None, :]).astype(jnp.float32)
+    gy = (jnp.arange(H)[None, None, :, None]).astype(jnp.float32)
+    sx = jax.nn.sigmoid(pred[:, :, 0]) * scale_x_y \
+        - (scale_x_y - 1.0) / 2.0
+    sy = jax.nn.sigmoid(pred[:, :, 1]) * scale_x_y \
+        - (scale_x_y - 1.0) / 2.0
+    bx = (gx + sx) / W
+    by = (gy + sy) / H
+    input_w = W * downsample_ratio
+    input_h = H * downsample_ratio
+    bw = jnp.exp(pred[:, :, 2]) * an[None, :, 0, None, None] / input_w
+    bh = jnp.exp(pred[:, :, 3]) * an[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(pred[:, :, 4])
+    probs = jax.nn.sigmoid(pred[:, :, 5:]) * conf[:, :, None]
+    img = _t(img_size)._array.astype(jnp.float32)   # (N, 2) h, w
+    imh, imw = img[:, 0], img[:, 1]
+    x1 = (bx - bw / 2) * imw[:, None, None, None]
+    y1 = (by - bh / 2) * imh[:, None, None, None]
+    x2 = (bx + bw / 2) * imw[:, None, None, None]
+    y2 = (by + bh / 2) * imh[:, None, None, None]
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, imw[:, None, None, None] - 1)
+        y1 = jnp.clip(y1, 0, imh[:, None, None, None] - 1)
+        x2 = jnp.clip(x2, 0, imw[:, None, None, None] - 1)
+        y2 = jnp.clip(y2, 0, imh[:, None, None, None] - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(N, -1, 4)
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(N, -1, class_num)
+    keep = conf.reshape(N, -1) > conf_thresh
+    boxes = boxes * keep[..., None]
+    scores = scores * keep[..., None]
+    return Tensor._from_array(boxes), Tensor._from_array(scores)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=True):
+    """RPN proposal generation (reference: vision/ops.py
+    generate_proposals): per image, decode anchor deltas, clip, filter
+    small boxes, NMS, keep top-N.  Eager (data-dependent sizes)."""
+    import numpy as np
+    sc = np.asarray(_t(scores)._array)           # (N, A, H, W)
+    bd = np.asarray(_t(bbox_deltas)._array)      # (N, 4A, H, W)
+    ims = np.asarray(_t(img_size)._array)        # (N, 2) h, w
+    an = np.asarray(_t(anchors)._array).reshape(-1, 4)
+    vr = np.asarray(_t(variances)._array).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    all_rois, all_scores, nums = [], [], []
+    for i in range(N):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)
+        d = bd[i].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], an[order], vr[order]
+        aw = a[:, 2] - a[:, 0] + 1.0
+        ah = a[:, 3] - a[:, 1] + 1.0
+        acx = a[:, 0] + aw * 0.5
+        acy = a[:, 1] + ah * 0.5
+        cx = d[:, 0] * v[:, 0] * aw + acx
+        cy = d[:, 1] * v[:, 1] * ah + acy
+        w = np.exp(np.minimum(d[:, 2] * v[:, 2], 10.0)) * aw
+        h = np.exp(np.minimum(d[:, 3] * v[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2, cy + h / 2], axis=1)
+        ih, iw = ims[i]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - 1)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - 1)
+        keep = ((boxes[:, 2] - boxes[:, 0] >= min_size)
+                & (boxes[:, 3] - boxes[:, 1] >= min_size))
+        boxes, s = boxes[keep], s[keep]
+        if boxes.shape[0]:
+            kept = nms(Tensor._from_array(jnp.asarray(boxes)),
+                       Tensor._from_array(jnp.asarray(s)),
+                       iou_threshold=nms_thresh)
+            kept = np.asarray(kept._array)[:post_nms_top_n]
+            boxes, s = boxes[kept], s[kept]
+        all_rois.append(boxes)
+        all_scores.append(s)
+        nums.append(boxes.shape[0])
+    rois = Tensor._from_array(jnp.asarray(
+        np.concatenate(all_rois, axis=0) if all_rois else
+        np.zeros((0, 4), np.float32)))
+    rscores = Tensor._from_array(jnp.asarray(np.concatenate(all_scores)))
+    out = (rois, rscores)
+    if return_rois_num:
+        out = out + (Tensor._from_array(jnp.asarray(nums, jnp.int32)),)
+    return out

@@ -398,3 +398,104 @@ class _FusedToTensorNormalize(BaseTransform):
                                      unit_scale=bool(arr.max() > 1.5))
             return Tensor(out)
         return self.normalize(self.to_tensor(img))
+
+
+# ----------------------------------------- round-3 functional transforms
+# (reference: python/paddle/vision/transforms/functional.py — the
+# class transforms above delegate to these same routines conceptually)
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format, to_rgb)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def crop(img, top, left, height, width):
+    arr = _hwc(img)
+    return arr[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
+
+
+def hflip(img):
+    return _hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _hwc(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    return Pad(padding, fill, padding_mode)(img)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    from scipy import ndimage
+    arr = _hwc(img)
+    order = {"nearest": 0, "bilinear": 1}.get(interpolation, 0)
+    return ndimage.rotate(arr, angle, reshape=expand, order=order,
+                          cval=fill, axes=(0, 1))
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)(img)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _hwc(img)
+    return _blend(arr, np.zeros_like(arr, np.float32), brightness_factor)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _hwc(img).astype(np.float32)
+    if arr.ndim == 3 and arr.shape[-1] == 3:
+        g = 0.299 * arr[..., 0] + 0.587 * arr[..., 1] + 0.114 * arr[..., 2]
+    else:
+        g = arr
+    return _blend(_hwc(img), np.full_like(arr, g.mean()), contrast_factor)
+
+
+def adjust_hue(img, hue_factor):
+    """DETERMINISTIC hue rotation by exactly hue_factor (in [-0.5, 0.5]
+    turns), unlike HueTransform which samples a random shift."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = _hwc(img)
+    if arr.ndim == 2 or arr.shape[-1] == 1:
+        return arr
+    int_in = np.issubdtype(arr.dtype, np.integer)
+    a = arr.astype(np.float32) / (255.0 if int_in else 1.0)
+    r, g, b = a[..., 0], a[..., 1], a[..., 2]
+    maxc = a[..., :3].max(axis=-1)
+    minc = a[..., :3].min(axis=-1)
+    v = maxc
+    c = maxc - minc
+    s = np.where(maxc > 0, c / np.maximum(maxc, 1e-12), 0.0)
+    safe_c = np.maximum(c, 1e-12)
+    h = np.where(
+        maxc == r, ((g - b) / safe_c) % 6.0,
+        np.where(maxc == g, (b - r) / safe_c + 2.0,
+                 (r - g) / safe_c + 4.0)) / 6.0
+    h = np.where(c > 0, h, 0.0)
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int32) % 6
+    r2 = np.choose(i, [v, q, p, p, t, v])
+    g2 = np.choose(i, [t, v, v, q, p, p])
+    b2 = np.choose(i, [p, p, t, v, v, q])
+    out = np.stack([r2, g2, b2], axis=-1)
+    if int_in:
+        return np.clip(out * 255.0, 0, 255).astype(arr.dtype)
+    return out.astype(arr.dtype)

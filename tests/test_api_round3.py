@@ -1,0 +1,372 @@
+"""Round-3 API-audit additions (tools/api_report.py --diff drove these;
+reference: the public python/paddle/* API index — see
+docs/api_coverage.md).  Numeric checks against numpy/known values."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+
+
+class TestTensorOps:
+    def test_aliases_and_views(self):
+        x = pt.to_tensor([[1., 2.], [3., 4.]])
+        np.testing.assert_allclose(pt.cat([x, x]).numpy().shape, (4, 2))
+        assert pt.t(x).numpy()[0, 1] == 3.0
+        assert pt.tolist(x) == [[1., 2.], [3., 4.]]
+        assert float(pt.add_n([x, x, x]).sum()) == 30.0
+        assert len(pt.unstack(x)) == 2
+        assert float(pt.floor_mod(pt.to_tensor(7), pt.to_tensor(3))) == 1
+
+    def test_complex_views(self):
+        c = pt.as_complex(pt.to_tensor([[3., 4.]]))
+        assert pt.is_complex(c)
+        r = pt.as_real(c)
+        np.testing.assert_allclose(r.numpy(), [[3., 4.]])
+
+    def test_stacking(self):
+        x = pt.to_tensor([[1., 2.], [3., 4.]])
+        assert pt.block_diag([x, x]).shape == [4, 4]
+        assert pt.hstack([x, x]).shape == [2, 4]
+        assert pt.vstack([x, x]).shape == [4, 2]
+        assert pt.dstack([x, x]).shape == [2, 2, 2]
+        cs = pt.column_stack([pt.to_tensor([1., 2.]),
+                              pt.to_tensor([3., 4.])])
+        np.testing.assert_allclose(cs.numpy(), [[1., 3.], [2., 4.]])
+
+    def test_splits(self):
+        parts = pt.tensor_split(pt.arange(0, 7), 3)
+        assert [int(p.shape[0]) for p in parts] == [3, 2, 2]
+        assert len(pt.vsplit(pt.randn([4, 2]), 2)) == 2
+        assert len(pt.hsplit(pt.randn([2, 4]), 2)) == 2
+
+    def test_cummax_cummin(self):
+        v, i = pt.cummax(pt.to_tensor([1., 3., 2., 5.]))
+        np.testing.assert_allclose(v.numpy(), [1, 3, 3, 5])
+        np.testing.assert_allclose(i.numpy(), [0, 1, 1, 3])
+        v2, _ = pt.cummin(pt.to_tensor([3., 1., 2., 0.]))
+        np.testing.assert_allclose(v2.numpy(), [3, 1, 1, 0])
+
+    def test_indexing_ops(self):
+        x = pt.to_tensor([[1., 2.], [3., 4.]])
+        ip = pt.index_put(x, [pt.to_tensor([0])], pt.to_tensor([9., 9.]))
+        np.testing.assert_allclose(ip.numpy(), [[9, 9], [3, 4]])
+        ipa = pt.index_put(x, [pt.to_tensor([0])], pt.to_tensor([1., 1.]),
+                           accumulate=True)
+        np.testing.assert_allclose(ipa.numpy(), [[2, 3], [3, 4]])
+        iss = pt.index_sample(x, pt.to_tensor([[1, 0], [0, 1]]))
+        np.testing.assert_allclose(iss.numpy(), [[2, 1], [3, 4]])
+        sn = pt.scatter_nd(pt.to_tensor([[0], [2]]),
+                           pt.to_tensor([1., 2.]), [4])
+        np.testing.assert_allclose(sn.numpy(), [1, 0, 2, 0])
+        mx = pt.multiplex([x, x * 10], pt.to_tensor([0, 1]))
+        np.testing.assert_allclose(mx.numpy(), [[1, 2], [30, 40]])
+
+    def test_math_ops(self):
+        x = pt.to_tensor([[1., 2.], [3., 4.]])
+        assert float(pt.inner(pt.to_tensor([1., 2.]),
+                              pt.to_tensor([3., 4.]))) == 11.0
+        assert pt.kron(x, x).shape == [4, 4]
+        np.testing.assert_allclose(
+            pt.logit(pt.to_tensor([0.5])).numpy(), [0.0], atol=1e-6)
+        assert float(pt.nanmedian(
+            pt.to_tensor([1., float("nan"), 3.]))) == 2.0
+        np.testing.assert_allclose(
+            pt.polygamma(pt.to_tensor([1.0]), 1).numpy(),
+            [np.pi ** 2 / 6], rtol=1e-4)
+        assert pt.sgn(pt.to_tensor([-5.])).numpy()[0] == -1.0
+        assert float(pt.dist(x, x)) == 0.0
+        assert pt.stanh(pt.to_tensor([0.0])).numpy()[0] == 0.0
+
+    def test_slicing_windows(self):
+        x = pt.to_tensor([[1., 2.], [3., 4.]])
+        assert pt.slice(x, [0], [0], [1]).shape == [1, 2]
+        assert pt.strided_slice(pt.arange(0, 10), [0], [0], [10],
+                                [2]).shape[0] == 5
+        uf = pt.unfold(pt.arange(0, 6).astype("float32"), 0, 2, 2)
+        np.testing.assert_allclose(uf.numpy(), [[0, 1], [2, 3], [4, 5]])
+        ti = pt.tril_indices(3)
+        assert ti.shape == [2, 6]
+        np.testing.assert_allclose(
+            pt.shard_index(pt.to_tensor([0, 1, 2, 3]), 4, 2, 0).numpy(),
+            [0, 1, -1, -1])
+
+    def test_grad_flows_through_new_ops(self):
+        x = pt.to_tensor([[1., 2.], [3., 4.]], stop_gradient=False)
+        loss = (pt.kron(x, x).sum() + pt.hstack([x, x]).sum()
+                + pt.block_diag([x, x]).sum())
+        loss.backward()
+        assert x.grad is not None
+        assert np.isfinite(x.grad.numpy()).all()
+
+
+class TestNNAdditions:
+    def test_pools_3d(self):
+        vol = pt.randn([2, 3, 4, 8, 8])
+        assert pt.nn.AvgPool3D(2)(vol).shape == [2, 3, 2, 4, 4]
+        assert pt.nn.MaxPool3D(2)(vol).shape == [2, 3, 2, 4, 4]
+        assert pt.nn.AdaptiveAvgPool3D(2)(vol).shape == [2, 3, 2, 2, 2]
+        assert F.adaptive_avg_pool1d(pt.randn([2, 3, 12]), 4).shape \
+            == [2, 3, 4]
+
+    def test_pool1d_matches_2d(self):
+        x = pt.randn([2, 3, 10])
+        o1 = F.max_pool1d(x, 2)
+        o2 = F.max_pool2d(x.unsqueeze(2), (1, 2)).squeeze(2)
+        np.testing.assert_allclose(o1.numpy(), o2.numpy())
+
+    def test_conv_transposes(self):
+        out = pt.nn.Conv1DTranspose(3, 5, 3, stride=2)(pt.randn([2, 3, 10]))
+        assert out.shape[:2] == [2, 5]
+        vol = pt.randn([2, 3, 4, 4, 4])
+        out3 = pt.nn.Conv3DTranspose(3, 5, 2, stride=2)(vol)
+        assert out3.shape == [2, 5, 8, 8, 8]
+
+    def test_conv3d_transpose_grads(self):
+        vol = pt.randn([1, 2, 3, 3, 3])
+        vol.stop_gradient = False
+        layer = pt.nn.Conv3DTranspose(2, 2, 2)
+        layer(vol).sum().backward()
+        assert np.isfinite(vol.grad.numpy()).all()
+
+    def test_norm_layers(self):
+        img = pt.randn([2, 3, 8, 8])
+        out = pt.nn.InstanceNorm1D(3)(pt.randn([2, 3, 10]))
+        np.testing.assert_allclose(out.numpy().mean(axis=2), 0.0,
+                                   atol=1e-5)
+        assert F.local_response_norm(img, 5).shape == list(img.shape)
+
+    def test_rnn_wrapper(self):
+        class Cell(pt.nn.RNNCellBase):
+            def __init__(self):
+                super().__init__()
+                self.hidden_size = 6
+                self.fc = pt.nn.Linear(4 + 6, 6)
+
+            def forward(self, x, h):
+                h2 = pt.tanh(self.fc(pt.concat([x, h], axis=-1)))
+                return h2, h2
+
+        y, s = pt.nn.RNN(Cell())(pt.randn([2, 5, 4]))
+        assert y.shape == [2, 5, 6] and s.shape == [2, 6]
+
+    def test_spectral_norm_layer(self):
+        pt.seed(0)
+        sn = pt.nn.SpectralNorm([4, 3], power_iters=20)
+        wn = sn(pt.randn([4, 3]))
+        sv = np.linalg.svd(wn.numpy())[1]
+        np.testing.assert_allclose(sv[0], 1.0, atol=0.05)
+
+    def test_losses(self):
+        x = pt.randn([2, 8])
+        assert F.cosine_embedding_loss(x, x, pt.to_tensor([1, -1])).shape \
+            == []
+        assert F.margin_ranking_loss(x, x * 0.5,
+                                     pt.ones([2, 8])).shape == []
+        assert F.multi_margin_loss(x, pt.to_tensor([1, 2])).shape == []
+        probs = F.softmax(pt.randn([2, 6, 4]))
+        assert F.dice_loss(probs, pt.randint(0, 4, [2, 6, 1])).shape == []
+        assert F.npair_loss(x, x, pt.to_tensor([0, 1])).shape == []
+        assert F.sigmoid_focal_loss(
+            x, (pt.randn([2, 8]) > 0).astype("float32")).shape == []
+        assert F.triplet_margin_with_distance_loss(
+            pt.randn([2, 4]), pt.randn([2, 4]), pt.randn([2, 4])).shape \
+            == []
+        pt.seed(0)
+        hs = pt.nn.HSigmoidLoss(8, 10)
+        assert hs(x, pt.to_tensor([3, 7])).shape == [2, 1]
+
+    def test_gather_tree(self):
+        ids = pt.to_tensor(np.array([[[2, 2]], [[3, 4]], [[5, 6]]],
+                                    np.int32))
+        parents = pt.to_tensor(np.array([[[0, 0]], [[0, 1]], [[1, 0]]],
+                                        np.int32))
+        out = F.gather_tree(ids, parents).numpy()
+        # beam 0 final token 5 came via parent 1 (token 4) via parent 0
+        np.testing.assert_array_equal(out[:, 0, 0], [2, 4, 5])
+
+    def test_beam_search_decoder(self):
+        pt.seed(1)
+        emb = pt.nn.Embedding(12, 4)
+        proj = pt.nn.Linear(6, 12)
+
+        class Cell(pt.nn.RNNCellBase):
+            def __init__(self):
+                super().__init__()
+                self.hidden_size = 6
+                self.fc = pt.nn.Linear(4 + 6, 6)
+
+            def forward(self, x, h):
+                h2 = pt.tanh(self.fc(pt.concat([x, h], axis=-1)))
+                return h2, h2
+
+        bsd = pt.nn.BeamSearchDecoder(
+            Cell(), start_token=1, end_token=2, beam_size=3,
+            embedding_fn=emb, output_fn=proj)
+        seqs, scores = bsd.decode(pt.zeros([6, 6]), batch_size=2,
+                                  max_steps=4)
+        assert seqs.shape == [4, 2, 3] and scores.shape == [2, 3]
+
+
+class TestNamespaces:
+    def test_distribution_additions(self):
+        D = pt.distribution
+        c = D.Cauchy(pt.to_tensor(0.0), pt.to_tensor(1.0))
+        np.testing.assert_allclose(float(c.log_prob(pt.to_tensor(0.0))),
+                                   -np.log(np.pi), rtol=1e-5)
+        pt.seed(0)
+        g = D.Geometric(pt.to_tensor(0.3))
+        assert abs(float(g.sample([3000]).mean()) - 0.7 / 0.3) < 0.35
+        ind = D.Independent(D.Normal(pt.zeros([3]), pt.ones([3])), 1)
+        np.testing.assert_allclose(float(ind.log_prob(pt.zeros([3]))),
+                                   3 * -0.5 * np.log(2 * np.pi), rtol=1e-5)
+
+        class Exp:
+            def forward(self, x):
+                return x.exp()
+
+            def inverse(self, y):
+                return y.log()
+
+            def forward_log_det_jacobian(self, x):
+                return x
+
+        td = D.TransformedDistribution(
+            D.Normal(pt.to_tensor(0.0), pt.to_tensor(1.0)), [Exp()])
+        np.testing.assert_allclose(float(td.log_prob(pt.to_tensor(1.0))),
+                                   -0.5 * np.log(2 * np.pi), rtol=1e-5)
+
+    def test_hub_local(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def mymodel(n=3):\n"
+            "    '''entrypoint doc'''\n"
+            "    import paddle_tpu as pt\n"
+            "    return pt.nn.Linear(n, n)\n")
+        d = str(tmp_path)
+        assert "mymodel" in pt.hub.list(d)
+        assert "entrypoint doc" in pt.hub.help(d, "mymodel")
+        m = pt.hub.load(d, "mymodel", n=4)
+        assert m(pt.ones([1, 4])).shape == [1, 4]
+        with pytest.raises(NotImplementedError):
+            pt.hub.load("user/repo", "x", source="github")
+
+    def test_distributed_additions(self):
+        d = pt.distributed
+        objs = []
+        d.all_gather_object(objs, {"a": 1})
+        assert objs[0]["a"] == 1
+        g = d.get_group()
+        assert g.nranks >= 1 and g.get_group_rank(0) == 0
+        d.destroy_process_group()
+        assert len(d.split(pt.ones([4, 2]), 2)) == 2
+
+    def test_linalg_metric_lr(self):
+        assert pt.linalg.matrix_norm(pt.randn([3, 3])).shape == []
+        assert pt.linalg.svdvals(pt.randn([3, 4])).shape == [3]
+        acc = pt.metric.accuracy(
+            pt.to_tensor([[0.1, 0.9], [0.8, 0.2]]), pt.to_tensor([1, 0]))
+        assert float(acc) == 1.0
+        s = pt.optimizer.lr.MultiplicativeDecay(1.0, lambda e: 0.5)
+        s.step(); s.step()
+        np.testing.assert_allclose(s.get_lr(), 0.25)
+
+    def test_vision_additions(self):
+        from paddle_tpu.vision.models import vgg11, vgg13  # noqa: F401
+        T = pt.vision.transforms
+        img = (np.random.rand(16, 16, 3) * 255).astype(np.uint8)
+        assert T.to_tensor(img).shape == [3, 16, 16]
+        assert T.resize(img, 8).shape == (8, 8, 3)
+        assert T.hflip(img).shape == img.shape
+        assert T.crop(img, 2, 2, 8, 8).shape == (8, 8, 3)
+        assert T.adjust_brightness(img, 1.2).shape == img.shape
+
+    def test_vision_ops_additions(self):
+        vo = pt.vision.ops
+        x = pt.randn([1, 8, 16, 16])
+        boxes = pt.to_tensor(np.array([[2., 2., 10., 10.]], np.float32))
+        bn = pt.to_tensor(np.array([1], np.int32))
+        assert vo.RoIAlign(4)(x, boxes, bn).shape == [1, 8, 4, 4]
+        assert vo.RoIPool(4)(x, boxes, bn).shape == [1, 8, 4, 4]
+        xp = pt.randn([1, 8, 16, 16])
+        assert vo.psroi_pool(xp, boxes, bn, 2).shape == [1, 2, 2, 2]
+        rois = pt.to_tensor(np.array([[0., 0., 10., 10.],
+                                      [0., 0., 200., 200.]], np.float32))
+        mr, restore, nums = vo.distribute_fpn_proposals(rois, 2, 5, 4, 224)
+        assert len(mr) == 4
+        # restore maps concatenated-by-level order back to input order
+        order = np.concatenate([np.asarray(r.numpy())[:, 2] for r in mr
+                                if r.shape[0]])
+        yx = pt.randn([1, 3 * 7, 4, 4])
+        img = pt.to_tensor(np.array([[64, 64]], np.int32))
+        bx, sc = vo.yolo_box(yx, img, [10, 13, 16, 30, 33, 23], 2, 0.01,
+                             16)
+        assert bx.shape == [1, 48, 4] and sc.shape == [1, 48, 2]
+        N, A, H, W = 1, 3, 8, 8
+        props, ps, nums2 = vo.generate_proposals(
+            pt.randn([N, A, H, W]), pt.randn([N, 4 * A, H, W]) * 0.1,
+            pt.to_tensor(np.array([[128., 128.]], np.float32)),
+            pt.randn([H, W, A, 4]).abs() * 20,
+            pt.ones([H, W, A, 4]) * 0.1,
+            pre_nms_top_n=50, post_nms_top_n=10)
+        assert props.shape[1] == 4
+        assert int(nums2.numpy()[0]) == props.shape[0]
+
+
+class TestSparseNN:
+    def _sample(self):
+        dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+        dense[0, 1, 1, 1] = [1.0, -2.0]
+        dense[0, 2, 3, 0] = [0.5, 4.0]
+        return dense, pt.sparse.SparseCooTensor.from_dense(
+            pt.to_tensor(dense))
+
+    def test_abs_relu(self):
+        _, x = self._sample()
+        assert float(pt.sparse.to_dense(pt.sparse.abs(x)).min()) >= 0
+        assert float(pt.sparse.to_dense(
+            pt.sparse.nn.ReLU()(x)).min()) >= 0.0
+
+    def test_batchnorm_matches_dense_masked(self):
+        dense, x = self._sample()
+        pt.seed(0)
+        bn = pt.sparse.nn.BatchNorm(2)
+        out = pt.sparse.to_dense(bn(x)).numpy()
+        # per-channel stats over NON-ZERO entries only
+        occ = np.abs(dense).sum(-1) > 0
+        for c in range(2):
+            vals = dense[..., c][occ]
+            expect = (vals - vals.mean()) / np.sqrt(vals.var() + 1e-5)
+            np.testing.assert_allclose(out[..., c][occ], expect,
+                                       rtol=1e-4)
+
+    def test_conv3d_matches_dense(self):
+        dense, x = self._sample()
+        pt.seed(0)
+        conv = pt.sparse.nn.Conv3D(2, 3, 3, padding=1, bias_attr=False)
+        out = pt.sparse.to_dense(conv(x)).numpy()
+        # dense reference: same conv, masked to the dilated occupancy
+        xt = np.moveaxis(dense, -1, 1)
+        w = conv.weight.numpy().transpose(4, 3, 0, 1, 2)
+        ref = pt.nn.functional.conv3d(
+            pt.to_tensor(xt), pt.to_tensor(w), padding=1).numpy()
+        ref = np.moveaxis(ref, 1, -1)
+        occ = (np.abs(dense).sum(-1, keepdims=True) > 0).astype(np.float32)
+        occ_t = np.moveaxis(occ, -1, 1)
+        occ_out = pt.nn.functional.conv3d(
+            pt.to_tensor(occ_t),
+            pt.to_tensor(np.ones((1, 1, 3, 3, 3), np.float32)),
+            padding=1).numpy()
+        mask = np.moveaxis(occ_out, 1, -1) > 0
+        np.testing.assert_allclose(out, ref * mask, rtol=1e-4, atol=1e-5)
+
+    def test_subm_conv3d_pattern_and_grad(self):
+        dense, x = self._sample()
+        pt.seed(0)
+        subm = pt.sparse.nn.SubmConv3D(2, 3, 3)
+        out = pt.sparse.to_dense(subm(x))
+        occ_in = np.abs(dense).sum(-1) > 0
+        occ_out = np.abs(out.numpy()).sum(-1) != 0
+        assert (occ_out <= occ_in).all()
+        out.sum().backward()
+        assert np.isfinite(subm.weight.grad.numpy()).all()
+        assert float(np.abs(subm.weight.grad.numpy()).sum()) > 0

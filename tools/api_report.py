@@ -1,23 +1,27 @@
 """Enumerate the public paddle_tpu API surface (judge/parity aid).
 
-Usage: JAX_PLATFORMS=cpu python tools/api_report.py
-Prints per-namespace counts of public callables/classes and a total.
+Usage:
+    JAX_PLATFORMS=cpu python tools/api_report.py           # counts
+    JAX_PLATFORMS=cpu python tools/api_report.py --diff    # coverage vs
+        the checked-in public-Paddle inventory (paddle_public_api.txt,
+        reconstructed from the reference's documented API index), with
+        per-namespace coverage % and the missing-symbol list.
 """
 from __future__ import annotations
 
+import argparse
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+_INVENTORY = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "paddle_public_api.txt")
 
-def main():
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-    import paddle_tpu as pt
 
-    namespaces = [
+def _namespaces(pt):
+    return [
         ("paddle", pt), ("paddle.nn", pt.nn),
         ("paddle.nn.functional", pt.nn.functional),
         ("paddle.nn.initializer", pt.nn.initializer),
@@ -31,7 +35,9 @@ def main():
         ("paddle.text", pt.text), ("paddle.linalg", pt.linalg),
         ("paddle.fft", pt.fft), ("paddle.signal", pt.signal),
         ("paddle.distribution", pt.distribution),
-        ("paddle.sparse", pt.sparse), ("paddle.geometric", pt.geometric),
+        ("paddle.sparse", pt.sparse),
+        ("paddle.sparse.nn", getattr(pt.sparse, "nn", None)),
+        ("paddle.geometric", pt.geometric),
         ("paddle.incubate.nn", pt.incubate.nn),
         ("paddle.static", pt.static), ("paddle.jit", pt.jit),
         ("paddle.amp", pt.amp), ("paddle.metric", pt.metric),
@@ -39,7 +45,59 @@ def main():
         ("paddle.quantization", pt.quantization),
         ("paddle.utils", pt.utils), ("paddle.inference", pt.inference),
         ("paddle.autograd", pt.autograd), ("paddle.hapi", pt.hapi),
+        ("paddle.hub", getattr(pt, "hub", None)),
+        ("paddle.onnx", pt.onnx),
     ]
+
+
+def _load_inventory():
+    inv = {}
+    with open(_INVENTORY) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            ns, name = line.split("\t")
+            inv.setdefault(ns, set()).add(name)
+    return inv
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--diff", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as pt
+
+    namespaces = [(n, m) for n, m in _namespaces(pt) if m is not None]
+
+    if args.diff:
+        inv = _load_inventory()
+        mods = dict(namespaces)
+        tot_have = tot_want = 0
+        missing_all = []
+        print(f"{'namespace':28s} {'have':>5s} {'inv':>5s} {'cov%':>6s}")
+        for ns in sorted(inv):
+            want = inv[ns]
+            mod = mods.get(ns)
+            have = {n for n in want
+                    if mod is not None and getattr(mod, n, None) is not None}
+            tot_have += len(have)
+            tot_want += len(want)
+            miss = sorted(want - have)
+            missing_all.extend((ns, m) for m in miss)
+            print(f"{ns:28s} {len(have):5d} {len(want):5d} "
+                  f"{100.0 * len(have) / len(want):5.1f}%")
+        print(f"{'TOTAL':28s} {tot_have:5d} {tot_want:5d} "
+              f"{100.0 * tot_have / tot_want:5.1f}%")
+        if missing_all:
+            print("\nmissing:")
+            for ns, m in missing_all:
+                print(f"  {ns}.{m}")
+        return
+
     total = 0
     n_tensor = len([m for m in dir(pt.Tensor) if not m.startswith("_")])
     print(f"{'namespace':34s} {'public symbols':>14s}")
