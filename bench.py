@@ -166,16 +166,25 @@ def _dev_str():
         return "?"
 
 
-def run_resnet(batch=256, steps=20, warmup=3, s2d_stem=True):
+def run_resnet(batch=256, steps=20, warmup=3, s2d_stem=True,
+               data_format=None):
     """batch 256 beat 64/128/512 in the on-chip sweep (2147 vs 1797/2086/
     2094 img/s); s2d_stem runs the 7x7s2 stem as space-to-depth + 4x4 conv
-    (exact-parity MXU-utilization trick, ops/nn_kernels.py)."""
+    (exact-parity MXU-utilization trick, ops/nn_kernels.py); NHWC runs the
+    whole net channels-last (BENCH_RESNET_FORMAT / tools/resnet_tune.py
+    decide the default from the on-chip sweep)."""
     import paddle_tpu as pt
     import paddle_tpu.nn.functional as F
     from paddle_tpu.vision.models import resnet50
 
+    data_format = (data_format or os.environ.get("BENCH_RESNET_FORMAT",
+                                                 "NCHW")).upper()
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"BENCH_RESNET_FORMAT must be NCHW or NHWC, "
+                         f"got {data_format!r}")
     pt.seed(0)
-    model = resnet50(num_classes=1000, s2d_stem=s2d_stem)
+    model = resnet50(num_classes=1000, s2d_stem=s2d_stem,
+                     data_format=data_format)
     opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                                 parameters=model.parameters())
     model, opt = pt.amp.decorate(models=model, optimizers=opt,
@@ -185,7 +194,9 @@ def run_resnet(batch=256, steps=20, warmup=3, s2d_stem=True):
         return F.cross_entropy(m(x), y, reduction="mean")
 
     step = pt.jit.train_step(model, loss_fn, opt)
-    x = pt.randn([batch, 3, 224, 224], dtype="bfloat16")
+    shape = [batch, 3, 224, 224] if data_format == "NCHW" else \
+        [batch, 224, 224, 3]
+    x = pt.randn(shape, dtype="bfloat16")
     y = pt.randint(0, 1000, [batch])
     for _ in range(warmup):
         loss = step(x, y)

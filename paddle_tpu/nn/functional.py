@@ -181,7 +181,12 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
-               return_mask=False):
+               return_mask=False, data_format="NCHW"):
+    if data_format == "NHWC":
+        if return_mask:
+            raise NotImplementedError("return_mask with NHWC pooling")
+        return ops.call("max_pool2d_nhwc", _t(x), kernel_size=kernel_size,
+                        stride=stride, padding=padding, ceil_mode=ceil_mode)
     out = ops.call("max_pool2d", _t(x), kernel_size=kernel_size,
                    stride=stride, padding=padding, ceil_mode=ceil_mode)
     if not return_mask:
@@ -194,13 +199,17 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
-               exclusive=True):
-    return ops.call("avg_pool2d", _t(x), kernel_size=kernel_size,
+               exclusive=True, data_format="NCHW"):
+    op = "avg_pool2d_nhwc" if data_format == "NHWC" else "avg_pool2d"
+    return ops.call(op, _t(x), kernel_size=kernel_size,
                     stride=stride, padding=padding, ceil_mode=ceil_mode,
                     exclusive=exclusive)
 
 
-def adaptive_avg_pool2d(x, output_size):
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    if data_format == "NHWC":
+        return ops.call("adaptive_avg_pool2d_nhwc", _t(x),
+                        output_size=output_size)
     return ops.call("adaptive_avg_pool2d", _t(x), output_size=output_size)
 
 

@@ -12,10 +12,12 @@ class MaxPool2D(Layer):
         self.kernel_size, self.stride = kernel_size, stride
         self.padding, self.ceil_mode = padding, ceil_mode
         self.return_mask = return_mask
+        self.data_format = data_format
 
     def forward(self, x):
         return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            self.ceil_mode, return_mask=self.return_mask)
+                            self.ceil_mode, return_mask=self.return_mask,
+                            data_format=self.data_format)
 
 
 class AvgPool2D(Layer):
@@ -25,19 +27,23 @@ class AvgPool2D(Layer):
         self.kernel_size, self.stride = kernel_size, stride
         self.padding, self.ceil_mode = padding, ceil_mode
         self.exclusive = exclusive
+        self.data_format = data_format
 
     def forward(self, x):
         return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            self.ceil_mode, self.exclusive)
+                            self.ceil_mode, self.exclusive,
+                            data_format=self.data_format)
 
 
 class AdaptiveAvgPool2D(Layer):
     def __init__(self, output_size, data_format="NCHW"):
         super().__init__()
         self.output_size = output_size
+        self.data_format = data_format
 
     def forward(self, x):
-        return F.adaptive_avg_pool2d(x, self.output_size)
+        return F.adaptive_avg_pool2d(x, self.output_size,
+                                     data_format=self.data_format)
 
 
 class AdaptiveMaxPool2D(Layer):

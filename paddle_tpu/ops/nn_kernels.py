@@ -628,3 +628,70 @@ def gather_tree_k(ids, parents):
                             ids.shape[1:])
     _, out = lax.scan(body, init, (ids[::-1], parents[::-1]))
     return out[::-1]
+
+
+@register("max_pool2d_nhwc")
+def max_pool2d_nhwc_k(x, kernel_size, stride=None, padding=0,
+                      ceil_mode=False):
+    k = _pair(kernel_size)
+    s = _pair(stride if stride is not None else kernel_size)
+    p = _conv_padding(padding, 2)
+    if isinstance(p, str):
+        raise ValueError("string padding unsupported for pool")
+    if ceil_mode:
+        p = [(p[i][0], p[i][1] + _ceil_extra(x.shape[1 + i], k[i], s[i],
+                                             p[i])) for i in range(2)]
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+        jnp.iinfo(x.dtype).min
+    return lax.reduce_window(
+        x, init, lax.max, (1,) + k + (1,), (1,) + s + (1,),
+        [(0, 0)] + list(p) + [(0, 0)])
+
+
+@register("adaptive_avg_pool2d_nhwc")
+def adaptive_avg_pool2d_nhwc_k(x, output_size):
+    oh, ow = _pair(output_size)
+    _, h, w, _ = x.shape
+    if h % oh == 0 and w % ow == 0:
+        x6 = x.reshape(x.shape[0], oh, h // oh, ow, w // ow, x.shape[3])
+        return x6.mean(axis=(2, 4))
+    # non-divisible: reuse the NCHW kernel's general slice-and-mean path
+    out = adaptive_avg_pool2d_k(jnp.moveaxis(x, -1, 1), output_size)
+    return jnp.moveaxis(out, 1, -1)
+
+
+@register("s2d_stem_conv_nhwc", amp="allow")
+def s2d_stem_conv_nhwc_k(x, w):
+    """NHWC variant of the space-to-depth 7x7/s2 stem trick: x [b, H, W, c]
+    (H, W even); w [o, c, 7, 7] (same OIHW weights as the NCHW path)."""
+    b, H, W, c = x.shape
+    o = w.shape[0]
+    z = x.reshape(b, H // 2, 2, W // 2, 2, c)
+    z = z.transpose(0, 1, 3, 2, 4, 5).reshape(b, H // 2, W // 2, c * 4)
+    w8 = jnp.pad(w, ((0, 0), (0, 0), (1, 0), (1, 0)))
+    w4 = w8.reshape(o, c, 4, 2, 4, 2)
+    # channel packing must match: z channels are (hp, wp, c)-ordered ->
+    # weight taps reordered to (2, 2, c) leading
+    w4 = w4.transpose(0, 3, 5, 1, 2, 4).reshape(o, 4 * c, 4, 4)
+    return lax.conv_general_dilated(
+        z, w4, window_strides=(1, 1), padding=((2, 1), (2, 1)),
+        dimension_numbers=("NHWC", "OIHW", "NHWC"))
+
+
+@register("avg_pool2d_nhwc")
+def avg_pool2d_nhwc_k(x, kernel_size, stride=None, padding=0,
+                      ceil_mode=False, exclusive=True):
+    k = _pair(kernel_size)
+    s = _pair(stride if stride is not None else kernel_size)
+    p = _conv_padding(padding, 2)
+    if ceil_mode:
+        p = [(p[i][0], p[i][1] + _ceil_extra(x.shape[1 + i], k[i], s[i],
+                                             p[i])) for i in range(2)]
+    win, strides = (1,) + k + (1,), (1,) + s + (1,)
+    pads = [(0, 0)] + list(p) + [(0, 0)]
+    summed = lax.reduce_window(x, 0.0, lax.add, win, strides, pads)
+    if exclusive and any(pi != (0, 0) for pi in p):
+        counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, win,
+                                   strides, pads)
+        return summed / jnp.maximum(counts, 1.0)
+    return summed / (k[0] * k[1])

@@ -1,22 +1,46 @@
-"""ResNet family (reference: python/paddle/vision/models/resnet.py)."""
+"""ResNet family (reference: python/paddle/vision/models/resnet.py).
+
+`data_format="NHWC"` (round 3) runs every conv/BN/pool channels-last —
+the layout the TPU's vector units natively prefer (channels on the
+128-lane minor dimension, no relayout transposes around each conv);
+weights keep the reference OIHW layout so state_dicts are
+format-independent.
+"""
 from __future__ import annotations
 
+import inspect
+
 from ... import nn
+
+
+def _mk_norm(norm_layer, num_features, data_format):
+    """Pass data_format only to norm classes that accept it — custom
+    norm_layer callables (GroupNorm lambdas, ...) keep working."""
+    try:
+        params = inspect.signature(norm_layer).parameters
+    except (TypeError, ValueError):  # builtins / odd callables
+        params = {}
+    if "data_format" in params:
+        return norm_layer(num_features, data_format=data_format)
+    return norm_layer(num_features)
 
 
 class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
+        df = {"data_format": data_format}
         self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1,
-                               bias_attr=False)
-        self.bn1 = norm_layer(planes)
+                               bias_attr=False, **df)
+        self.bn1 = _mk_norm(norm_layer, planes, data_format)
         self.relu = nn.ReLU()
-        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False)
-        self.bn2 = norm_layer(planes)
+        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1,
+                               bias_attr=False, **df)
+        self.bn2 = _mk_norm(norm_layer, planes, data_format)
         self.downsample = downsample
         self.stride = stride
 
@@ -33,19 +57,21 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
+        df = {"data_format": data_format}
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
-        self.bn1 = norm_layer(width)
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False, **df)
+        self.bn1 = _mk_norm(norm_layer, width, data_format)
         self.conv2 = nn.Conv2D(width, width, 3, stride=stride, padding=dilation,
                                groups=groups, dilation=dilation,
-                               bias_attr=False)
-        self.bn2 = norm_layer(width)
+                               bias_attr=False, **df)
+        self.bn2 = _mk_norm(norm_layer, width, data_format)
         self.conv3 = nn.Conv2D(width, planes * self.expansion, 1,
-                               bias_attr=False)
-        self.bn3 = norm_layer(planes * self.expansion)
+                               bias_attr=False, **df)
+        self.bn3 = _mk_norm(norm_layer, planes * self.expansion, data_format)
         self.relu = nn.ReLU()
         self.downsample = downsample
 
@@ -61,7 +87,8 @@ class BottleneckBlock(nn.Layer):
 
 class ResNet(nn.Layer):
     def __init__(self, block, depth=50, width=64, num_classes=1000,
-                 with_pool=True, groups=1, s2d_stem=False):
+                 with_pool=True, groups=1, s2d_stem=False,
+                 data_format="NCHW"):
         super().__init__()
         layer_cfg = {
             18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
@@ -74,45 +101,52 @@ class ResNet(nn.Layer):
         self.with_pool = with_pool
         self.inplanes = 64
         self.dilation = 1
+        self.data_format = data_format
+        df = {"data_format": data_format}
 
         # s2d_stem: run the 7x7/s2 stem as space-to-depth + 4x4 conv (same
         # parameter, numerically identical — ops/nn_kernels s2d_stem_conv);
         # ~12x better MXU lane utilization on the 3-channel input
         self.s2d_stem = bool(s2d_stem)
         self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3,
-                               bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(self.inplanes)
+                               bias_attr=False, **df)
+        self.bn1 = nn.BatchNorm2D(self.inplanes, **df)
         self.relu = nn.ReLU()
-        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1, **df)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1), **df)
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
     def _make_layer(self, block, planes, blocks, stride=1):
+        df = {"data_format": self.data_format}
         downsample = None
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1,
-                          stride=stride, bias_attr=False),
-                nn.BatchNorm2D(planes * block.expansion),
+                          stride=stride, bias_attr=False, **df),
+                nn.BatchNorm2D(planes * block.expansion, **df),
             )
         layers = [block(self.inplanes, planes, stride, downsample,
-                        self.groups, self.base_width)]
+                        self.groups, self.base_width, **df)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes, groups=self.groups,
-                                base_width=self.base_width))
+                                base_width=self.base_width, **df))
         return nn.Sequential(*layers)
 
     def forward(self, x):
-        if self.s2d_stem and x.shape[-1] % 2 == 0 and x.shape[-2] % 2 == 0:
+        nhwc = self.data_format == "NHWC"
+        sdim = (1, 2) if nhwc else (2, 3)
+        if self.s2d_stem and x.shape[sdim[0]] % 2 == 0 \
+                and x.shape[sdim[1]] % 2 == 0:
             from ... import ops
-            x = ops.call("s2d_stem_conv", x, self.conv1.weight)
+            x = ops.call("s2d_stem_conv_nhwc" if nhwc else "s2d_stem_conv",
+                         x, self.conv1.weight)
         else:
             x = self.conv1(x)
         x = self.relu(self.bn1(x))

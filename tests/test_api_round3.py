@@ -370,3 +370,38 @@ class TestSparseNN:
         out.sum().backward()
         assert np.isfinite(subm.weight.grad.numpy()).all()
         assert float(np.abs(subm.weight.grad.numpy()).sum()) > 0
+
+
+class TestNHWCResNet:
+    def test_nhwc_matches_nchw(self):
+        from paddle_tpu.vision.models import resnet18
+        for s2d in (False, True):
+            pt.seed(0)
+            m1 = resnet18(num_classes=10, s2d_stem=s2d)
+            pt.seed(0)
+            m2 = resnet18(num_classes=10, s2d_stem=s2d,
+                          data_format="NHWC")
+            m2.set_state_dict(m1.state_dict())
+            m1.eval(); m2.eval()
+            x = np.random.RandomState(0).randn(2, 3, 32, 32).astype(
+                np.float32)
+            o1 = m1(pt.to_tensor(x))
+            o2 = m2(pt.to_tensor(x.transpose(0, 2, 3, 1)))
+            np.testing.assert_allclose(o1.numpy(), o2.numpy(), atol=1e-4,
+                                       err_msg=f"s2d={s2d}")
+
+    def test_nhwc_trains(self):
+        from paddle_tpu.vision.models import resnet18
+        pt.seed(0)
+        m = resnet18(num_classes=4, data_format="NHWC")
+        opt = pt.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                    parameters=m.parameters())
+
+        def loss_fn(mm, x, y):
+            return F.cross_entropy(mm(x), y, reduction="mean")
+
+        step = pt.jit.train_step(m, loss_fn, opt)
+        x = pt.randn([4, 32, 32, 3])
+        y = pt.randint(0, 4, [4])
+        losses = [float(step(x, y)) for _ in range(6)]
+        assert losses[-1] < losses[0], losses
