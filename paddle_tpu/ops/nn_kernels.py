@@ -630,22 +630,33 @@ def gather_tree_k(ids, parents):
     return out[::-1]
 
 
-@register("max_pool2d_nhwc")
-def max_pool2d_nhwc_k(x, kernel_size, stride=None, padding=0,
-                      ceil_mode=False):
+def _pool2d_geom(x, kernel_size, stride, padding, ceil_mode, ch_last):
+    """Shared window/stride/pad geometry for NCHW (ch_last=False) and
+    NHWC pooling — one copy of the arithmetic, axis placement decided
+    here (review: the NCHW/NHWC kernel pair had drifted)."""
     k = _pair(kernel_size)
     s = _pair(stride if stride is not None else kernel_size)
     p = _conv_padding(padding, 2)
     if isinstance(p, str):
         raise ValueError("string padding unsupported for pool")
+    off = 1 if ch_last else 2
     if ceil_mode:
-        p = [(p[i][0], p[i][1] + _ceil_extra(x.shape[1 + i], k[i], s[i],
+        p = [(p[i][0], p[i][1] + _ceil_extra(x.shape[off + i], k[i], s[i],
                                              p[i])) for i in range(2)]
+    if ch_last:
+        return ((1,) + k + (1,), (1,) + s + (1,),
+                [(0, 0)] + list(p) + [(0, 0)], k, p)
+    return ((1, 1) + k, (1, 1) + s, [(0, 0), (0, 0)] + list(p), k, p)
+
+
+@register("max_pool2d_nhwc")
+def max_pool2d_nhwc_k(x, kernel_size, stride=None, padding=0,
+                      ceil_mode=False):
+    win, strides, pads, _, _ = _pool2d_geom(x, kernel_size, stride,
+                                            padding, ceil_mode, True)
     init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
         jnp.iinfo(x.dtype).min
-    return lax.reduce_window(
-        x, init, lax.max, (1,) + k + (1,), (1,) + s + (1,),
-        [(0, 0)] + list(p) + [(0, 0)])
+    return lax.reduce_window(x, init, lax.max, win, strides, pads)
 
 
 @register("adaptive_avg_pool2d_nhwc")
@@ -681,14 +692,8 @@ def s2d_stem_conv_nhwc_k(x, w):
 @register("avg_pool2d_nhwc")
 def avg_pool2d_nhwc_k(x, kernel_size, stride=None, padding=0,
                       ceil_mode=False, exclusive=True):
-    k = _pair(kernel_size)
-    s = _pair(stride if stride is not None else kernel_size)
-    p = _conv_padding(padding, 2)
-    if ceil_mode:
-        p = [(p[i][0], p[i][1] + _ceil_extra(x.shape[1 + i], k[i], s[i],
-                                             p[i])) for i in range(2)]
-    win, strides = (1,) + k + (1,), (1,) + s + (1,)
-    pads = [(0, 0)] + list(p) + [(0, 0)]
+    win, strides, pads, k, p = _pool2d_geom(x, kernel_size, stride,
+                                            padding, ceil_mode, True)
     summed = lax.reduce_window(x, 0.0, lax.add, win, strides, pads)
     if exclusive and any(pi != (0, 0) for pi in p):
         counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, win,

@@ -1,5 +1,7 @@
 """Model zoo: NLP families (reference analog: PaddleNLP transformers)."""
 from . import datasets  # noqa: F401
+from . import tokenizer  # noqa: F401
+from .tokenizer import BPETokenizer, CharTokenizer  # noqa: F401
 from .gpt import (  # noqa: F401
     GPTConfig, GPTModel, GPTForCausalLM, GPTBlock, GPTAttention, GPTMLP,
     GPTPretrainingCriterion, gpt_loss_fn,

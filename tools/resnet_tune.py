@@ -30,8 +30,9 @@ def main():
     fmts = ["NCHW", "NHWC"]
     s2ds = [True, False]
     batches = [256] if args.quick else [256, 512]
-    # ResNet-50 fwd ~4.1 GFLOP @224; train ~3x fwd
-    train_flops = 3 * 4.1e9
+    # ResNet-50 fwd ~4.1 GMAC @224 = 8.2 GFLOP (2 flops/MAC, matching
+    # bench.py's 6*N*tps convention); train ~3x fwd
+    train_flops = 3 * 2 * 4.1e9
     peak = bench.PEAK_TFLOPS * 1e12
 
     results = []
