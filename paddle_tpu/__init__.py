@@ -9,6 +9,7 @@ from .dtypes import (  # noqa: F401
     float64, float32, float16, bfloat16, int64, int32, int16, int8, uint8,
     bool_ as bool8, complex64, complex128,
     set_default_dtype, get_default_dtype, finfo, iinfo,
+    enable_x64, x64_enabled,
 )
 from . import device  # noqa: F401
 from .device import (  # noqa: F401

@@ -106,3 +106,22 @@ def dtype_name(dtype) -> str:
     if d == bfloat16:
         return "bfloat16"
     return np.dtype(d).name
+
+
+def enable_x64(flag: bool = True):
+    """Opt into REAL 64-bit dtypes (fp64/int64/complex128).
+
+    With the flag off (default), 64-bit requests resolve to 32-bit —
+    the TPU-native policy above.  Enabling flips jax's x64 mode so
+    `to_tensor(..., 'float64')` really is float64 — intended for
+    CPU-side numerics validation of ported code; XLA:TPU has no fast
+    64-bit path.  Call before creating tensors (existing arrays keep
+    their dtype; jit caches key on dtype so mixing modes recompiles).
+    """
+    import jax
+    jax.config.update("jax_enable_x64", bool(flag))
+
+
+def x64_enabled() -> bool:
+    import jax
+    return bool(jax.config.jax_enable_x64)

@@ -132,19 +132,11 @@ def _ceil_extra(size, k, s, p):
 
 @register("max_pool2d")
 def max_pool2d_k(x, kernel_size, stride=None, padding=0, ceil_mode=False):
-    k = _pair(kernel_size)
-    s = _pair(stride if stride is not None else kernel_size)
-    p = _conv_padding(padding, 2)
-    if isinstance(p, str):
-        raise ValueError("string padding unsupported for pool")
-    if ceil_mode:
-        p = [(p[i][0], p[i][1] + _ceil_extra(x.shape[2 + i], k[i], s[i],
-                                             p[i])) for i in range(2)]
+    win, strides, pads, _, _ = _pool2d_geom(x, kernel_size, stride,
+                                            padding, ceil_mode, False)
     init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
         jnp.iinfo(x.dtype).min
-    return lax.reduce_window(
-        x, init, lax.max, (1, 1) + k, (1, 1) + s,
-        [(0, 0), (0, 0)] + list(p))
+    return lax.reduce_window(x, init, lax.max, win, strides, pads)
 
 
 @register("max_pool2d_index")
@@ -185,15 +177,8 @@ def max_pool2d_index_k(x, kernel_size, stride=None, padding=0,
 @register("avg_pool2d")
 def avg_pool2d_k(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                  exclusive=True):
-    k = _pair(kernel_size)
-    s = _pair(stride if stride is not None else kernel_size)
-    p = _conv_padding(padding, 2)
-    if ceil_mode:
-        p = [(p[i][0], p[i][1] + _ceil_extra(x.shape[2 + i], k[i], s[i],
-                                             p[i])) for i in range(2)]
-    win = (1, 1) + k
-    strides = (1, 1) + s
-    pads = [(0, 0), (0, 0)] + list(p)
+    win, strides, pads, k, p = _pool2d_geom(x, kernel_size, stride,
+                                            padding, ceil_mode, False)
     summed = lax.reduce_window(x, 0.0, lax.add, win, strides, pads)
     if exclusive and any(pi != (0, 0) for pi in p):
         ones = jnp.ones_like(x)

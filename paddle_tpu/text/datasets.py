@@ -61,7 +61,6 @@ class LMTextDataset(Dataset):
     seq_len."""
 
     def __init__(self, path, tokenizer, seq_len=128, stride=None):
-        import numpy as np
         with open(path, encoding="utf-8") as f:
             ids = tokenizer.encode(f.read())
         self.seq_len = seq_len

@@ -170,8 +170,11 @@ class CharTokenizer:
     def __init__(self, chars=None):
         chars = sorted(set(chars or ""))
         self.vocab = {c: i for i, c in enumerate(chars)}
-        self.unk_id = len(self.vocab)
-        self.vocab[self.UNK] = self.unk_id
+        if self.UNK in self.vocab:      # corpus contained U+FFFD itself
+            self.unk_id = self.vocab[self.UNK]
+        else:
+            self.unk_id = len(self.vocab)
+            self.vocab[self.UNK] = self.unk_id
         self._inv = {i: c for c, i in self.vocab.items()}
 
     @classmethod
