@@ -33,11 +33,15 @@ _TEST_REMAP = {
     "dropout2d_k": lambda x, key=None, p=0.5: x,
 }
 # ops whose per-run randomness must be re-threaded instead of replaying
-# the build-time key baked into consts.  (Key-less creation RNG —
-# uniform_k/normal_k with no tensor inputs — is a known capture boundary:
-# it records no node and bakes as a constant, like the reference's
-# startup-program initializers.)
-_RNG_OPS = {"dropout_k", "dropout_nodiv_k", "dropout2d_k"}
+# the build-time key baked into consts.  Key-less creation RNG
+# (paddle.uniform/randn/... in static mode) registers here too via
+# record_rng_creation, and tensor-input samplers (bernoulli/multinomial)
+# dispatch with key consts — round 3 lifted the round-2 capture boundary
+# where all of these froze into build-time constants.  Host-side
+# randomness that never touches the dispatch layer (np.random on
+# .numpy() reads) remains a documented boundary.
+_RNG_OPS = {"dropout_k", "dropout_nodiv_k", "dropout2d_k",
+            "bernoulli_k", "multinomial_k"}
 
 
 def enabled() -> bool:
@@ -241,6 +245,13 @@ def record_op(name, fn, tensor_args, consts, result):
         # reuse) must not splice that graph in here — re-capture by value
         if sym is not None and sym[0].graph_id != prog.graph_id:
             sym = None
+        if sym is None and getattr(t, "_pending_creation", None) is not None:
+            if t.persistable or not t.stop_gradient:
+                # registered buffer/param built from randn/uniform: live
+                # leaf state, NOT per-run re-randomization
+                t._pending_creation = None
+            else:
+                sym = _materialize_creation(prog, t)
         parents.append(sym if sym is not None else prog.leaf_for(t))
     outs = result if isinstance(result, tuple) else (result,)
     node = OpNode(name, fn, parents, dict(consts or {}), len(outs),
@@ -359,6 +370,11 @@ class Executor:
         refs = []
         for t in fetch_list:
             sym = getattr(t, "_sym", None)
+            if (sym is None or sym[0].graph_id != prog.graph_id) and \
+                    getattr(t, "_pending_creation", None) is not None:
+                # fetching a creation-RNG tensor that was never consumed
+                # by a recorded op: materialize it now so it re-draws
+                sym = _materialize_creation(prog, t)
             if sym is None or sym[0].graph_id != prog.graph_id:
                 raise ValueError(
                     "fetch target was not recorded in this program (it was "
@@ -532,3 +548,36 @@ def register_minimize(optimizer, loss):
         raise NotImplementedError(
             "one optimizer per static Program is supported")
     prog._train = {"optimizer": optimizer, "loss_ref": sym}
+
+
+def record_rng_creation(name, fn, key, result):
+    """Mark a key-less creation RNG tensor (paddle.uniform/randn/... in
+    static mode) as a PENDING creation node — round-2's capture boundary
+    where creation randomness froze into build-time constants.
+
+    Lazy on purpose: the node is materialized into the Program only when
+    the tensor is actually USED in a recorded op (record_op below).
+    Appending eagerly would (a) grow prog.ops with dead nodes on every
+    feed-building pt.randn call, busting the Executor's len(ops)-keyed
+    jit cache, and (b) re-draw tensors that later become registered
+    buffers/params — persistable state must replay as LIVE leaves, never
+    as fresh randomness.
+
+    `fn(key=...)` must regenerate the array from a key alone (shape/dtype
+    closed over); `name` joins _RNG_OPS so replay substitutes a fresh
+    fold_in(run_key, seq) for the build-time key."""
+    if not _state["enabled"]:
+        return
+    result._pending_creation = (name, fn, key)
+
+
+def _materialize_creation(prog, t):
+    """Turn a pending creation mark into a real OpNode (first use)."""
+    name, fn, key = t._pending_creation
+    _RNG_OPS.add(name)
+    node = OpNode(name, fn, [], {"key": key}, 1, prog.graph_id,
+                  next(prog._node_seq))
+    prog.ops.append(node)
+    t._sym = (node, 0)
+    t._pending_creation = None
+    return (node, 0)

@@ -553,3 +553,21 @@ def _unfold_k(x, axis, size, step):
 
 
 register("unfold_tensor", _unfold_k)
+
+register("bernoulli_k", lambda x, key: jax.random.bernoulli(
+    key, x).astype(x.dtype))
+
+
+def _multinomial_k(x, key, num_samples=1, replacement=False):
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(
+            key, logits, axis=-1,
+            shape=(num_samples,) + logits.shape[:-1]).T
+    else:
+        g = jax.random.gumbel(key, logits.shape)
+        out = jnp.argsort(-(logits + g), axis=-1)[..., :num_samples]
+    return out.astype(jnp.int32)
+
+
+register("multinomial_k", _multinomial_k)

@@ -132,8 +132,8 @@ def _ceil_extra(size, k, s, p):
 
 @register("max_pool2d")
 def max_pool2d_k(x, kernel_size, stride=None, padding=0, ceil_mode=False):
-    win, strides, pads, _, _ = _pool2d_geom(x, kernel_size, stride,
-                                            padding, ceil_mode, False)
+    win, strides, pads, _, _, _ = _pool2d_geom(x, kernel_size, stride,
+                                               padding, ceil_mode, False)
     init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
         jnp.iinfo(x.dtype).min
     return lax.reduce_window(x, init, lax.max, win, strides, pads)
@@ -145,14 +145,8 @@ def max_pool2d_index_k(x, kernel_size, stride=None, padding=0,
     """Argmax mask for max_pool2d: flat index into each (H, W) input map,
     matching the reference's max_pool2d(..., return_mask=True) second output
     (python/paddle/nn/functional/pooling.py)."""
-    k = _pair(kernel_size)
-    s = _pair(stride if stride is not None else kernel_size)
-    p = _conv_padding(padding, 2)
-    if isinstance(p, str):
-        raise ValueError("string padding unsupported for pool")
-    if ceil_mode:
-        p = [(p[i][0], p[i][1] + _ceil_extra(x.shape[2 + i], k[i], s[i],
-                                             p[i])) for i in range(2)]
+    _, _, _, k, p, s = _pool2d_geom(x, kernel_size, stride, padding,
+                                    ceil_mode, False)
     H, W = x.shape[2], x.shape[3]
     # -inf (not finfo.min) so padding never beats a real -inf input element,
     # matching max_pool2d_k's reduce_window init value
@@ -177,8 +171,8 @@ def max_pool2d_index_k(x, kernel_size, stride=None, padding=0,
 @register("avg_pool2d")
 def avg_pool2d_k(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                  exclusive=True):
-    win, strides, pads, k, p = _pool2d_geom(x, kernel_size, stride,
-                                            padding, ceil_mode, False)
+    win, strides, pads, k, p, _ = _pool2d_geom(x, kernel_size, stride,
+                                               padding, ceil_mode, False)
     summed = lax.reduce_window(x, 0.0, lax.add, win, strides, pads)
     if exclusive and any(pi != (0, 0) for pi in p):
         ones = jnp.ones_like(x)
@@ -630,15 +624,15 @@ def _pool2d_geom(x, kernel_size, stride, padding, ceil_mode, ch_last):
                                              p[i])) for i in range(2)]
     if ch_last:
         return ((1,) + k + (1,), (1,) + s + (1,),
-                [(0, 0)] + list(p) + [(0, 0)], k, p)
-    return ((1, 1) + k, (1, 1) + s, [(0, 0), (0, 0)] + list(p), k, p)
+                [(0, 0)] + list(p) + [(0, 0)], k, p, s)
+    return ((1, 1) + k, (1, 1) + s, [(0, 0), (0, 0)] + list(p), k, p, s)
 
 
 @register("max_pool2d_nhwc")
 def max_pool2d_nhwc_k(x, kernel_size, stride=None, padding=0,
                       ceil_mode=False):
-    win, strides, pads, _, _ = _pool2d_geom(x, kernel_size, stride,
-                                            padding, ceil_mode, True)
+    win, strides, pads, _, _, _ = _pool2d_geom(x, kernel_size, stride,
+                                               padding, ceil_mode, True)
     init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
         jnp.iinfo(x.dtype).min
     return lax.reduce_window(x, init, lax.max, win, strides, pads)
@@ -677,8 +671,8 @@ def s2d_stem_conv_nhwc_k(x, w):
 @register("avg_pool2d_nhwc")
 def avg_pool2d_nhwc_k(x, kernel_size, stride=None, padding=0,
                       ceil_mode=False, exclusive=True):
-    win, strides, pads, k, p = _pool2d_geom(x, kernel_size, stride,
-                                            padding, ceil_mode, True)
+    win, strides, pads, k, p, _ = _pool2d_geom(x, kernel_size, stride,
+                                               padding, ceil_mode, True)
     summed = lax.reduce_window(x, 0.0, lax.add, win, strides, pads)
     if exclusive and any(pi != (0, 0) for pi in p):
         counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, win,

@@ -39,7 +39,7 @@ class Tensor:
     __slots__ = ("_array", "stop_gradient", "grad", "_node", "_out_index",
                  "_retain_grads", "name", "persistable", "pspec",
                  "optimize_attr", "_sym", "_is_buffer", "_grad_hooks",
-                 "__weakref__")
+                 "_pending_creation", "__weakref__")
 
     def __init__(self, data=None, dtype=None, place=None, stop_gradient=True,
                  name=None):

@@ -136,59 +136,75 @@ def assign(x, output=None):
 
 
 # -------------------------------------------------------------------- random
+def _rng_creation(name, maker):
+    """Draw eagerly AND, in static mode, record a per-run-rethreaded
+    creation node (framework/static_graph.record_rng_creation)."""
+    key = _random.next_key()
+    t = Tensor._from_array(maker(key))
+    from .framework import static_graph as _sg
+    if _sg.enabled():
+        _sg.record_rng_creation(name, lambda key, _m=maker: _m(key), key, t)
+    return t
+
+
 def rand(shape, dtype=None):
-    return Tensor._from_array(jax.random.uniform(
-        _random.next_key(), tuple(shape), _dt(dtype)))
+    return _rng_creation(
+        "creation_rand",
+        lambda key, s=tuple(shape), d=_dt(dtype):
+            jax.random.uniform(key, s, d))
 
 
 def randn(shape, dtype=None):
-    return Tensor._from_array(jax.random.normal(
-        _random.next_key(), tuple(shape), _dt(dtype)))
+    return _rng_creation(
+        "creation_randn",
+        lambda key, s=tuple(shape), d=_dt(dtype):
+            jax.random.normal(key, s, d))
 
 
 def uniform(shape, dtype=None, min=-1.0, max=1.0):
-    return Tensor._from_array(jax.random.uniform(
-        _random.next_key(), tuple(shape), _dt(dtype), min, max))
+    return _rng_creation(
+        "creation_uniform",
+        lambda key, s=tuple(shape), d=_dt(dtype), lo=min, hi=max:
+            jax.random.uniform(key, s, d, lo, hi))
 
 
 def normal(mean=0.0, std=1.0, shape=None):
     if shape is None:
         shape = ()
-    return Tensor._from_array(
-        jax.random.normal(_random.next_key(), tuple(shape),
-                          dtypes.get_default_dtype()) * std + mean)
+    return _rng_creation(
+        "creation_normal",
+        lambda key, s=tuple(shape), d=dtypes.get_default_dtype(),
+               m=mean, sd=std:
+            jax.random.normal(key, s, d) * sd + m)
 
 
 def randint(low=0, high=None, shape=(1,), dtype=None):
     if high is None:
         low, high = 0, low
     d = dtypes.convert_dtype(dtype if dtype is not None else dtypes.int64)
-    return Tensor._from_array(jax.random.randint(
-        _random.next_key(), tuple(shape), low, high, dtype=d))
+    return _rng_creation(
+        "creation_randint",
+        lambda key, s=tuple(shape), lo=low, hi=high, dd=d:
+            jax.random.randint(key, s, lo, hi, dtype=dd))
 
 
 def randperm(n, dtype=None):
     d = dtypes.convert_dtype(dtype if dtype is not None else dtypes.int64)
-    return Tensor._from_array(
-        jax.random.permutation(_random.next_key(), n).astype(d))
+    return _rng_creation(
+        "creation_randperm",
+        lambda key, nn=n, dd=d:
+            jax.random.permutation(key, nn).astype(dd))
 
 
 def multinomial(x, num_samples=1, replacement=False):
-    logits = jnp.log(jnp.clip(_t(x)._array, 1e-30, None))
-    if replacement:
-        out = jax.random.categorical(
-            _random.next_key(), logits, axis=-1,
-            shape=(num_samples,) + logits.shape[:-1]).T
-    else:
-        k = _random.next_key()
-        g = jax.random.gumbel(k, logits.shape)
-        out = jnp.argsort(-(logits + g), axis=-1)[..., :num_samples]
-    return Tensor._from_array(out.astype(dtypes.convert_dtype(dtypes.int64)))
+    # keyed dispatch op (not ad-hoc jax.random): static capture re-threads
+    # the key per run like dropout
+    return ops.call("multinomial_k", _t(x), key=_random.next_key(),
+                    num_samples=num_samples, replacement=replacement)
 
 
 def bernoulli(x):
-    return Tensor._from_array(jax.random.bernoulli(
-        _random.next_key(), _t(x)._array).astype(_t(x)._array.dtype))
+    return ops.call("bernoulli_k", _t(x), key=_random.next_key())
 
 
 def seed(s):
