@@ -1,0 +1,53 @@
+"""BERT sequence classification with the high-level paddle.Model API
+(reference workflow: hapi fine-tuning examples)."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=32)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import paddle
+    from paddle.text import BertConfig, BertForSequenceClassification
+    from paddle.io import TensorDataset, DataLoader
+
+    paddle.seed(0)
+    cfg = BertConfig(vocab_size=512, hidden_size=64,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     intermediate_size=128,
+                     max_position_embeddings=args.seq)
+    net = BertForSequenceClassification(cfg, num_classes=2)
+
+    # synthetic task: class = (first token < 256)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 512, (256, args.seq)).astype(np.int64)
+    labels = (ids[:, 0] < 256).astype(np.int64)
+    ds = TensorDataset([paddle.to_tensor(ids), paddle.to_tensor(labels)])
+
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.AdamW(learning_rate=5e-4,
+                                         parameters=net.parameters()),
+        loss=paddle.nn.CrossEntropyLoss(),
+        metrics=paddle.metric.Accuracy())
+    model.fit(DataLoader(ds, batch_size=args.batch, shuffle=True),
+              epochs=args.epochs, verbose=1)
+    res = model.evaluate(DataLoader(ds, batch_size=args.batch), verbose=0)
+    print("eval:", res)
+
+
+if __name__ == "__main__":
+    main()

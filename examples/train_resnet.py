@@ -1,0 +1,92 @@
+"""ResNet-50 classification training (reference workflow: the
+paddle.vision resnet example), AMP bf16 + optional channels-last.
+
+    python examples/train_resnet.py --steps 20 [--cpu] [--nhwc]
+    python examples/train_resnet.py --data-dir imagenet/train  # ImageFolder
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", default=None,
+                    help="ImageFolder root (default: synthetic data)")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--image-size", type=int, default=64)
+    ap.add_argument("--depth", type=int, default=18,
+                    choices=[18, 34, 50, 101, 152])
+    ap.add_argument("--classes", type=int, default=10)
+    ap.add_argument("--nhwc", action="store_true",
+                    help="channels-last layout (TPU-preferred)")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import paddle
+    import paddle.nn.functional as F
+    from paddle.vision import models as M
+
+    fmt = "NHWC" if args.nhwc else "NCHW"
+    paddle.seed(0)
+    model = getattr(M, f"resnet{args.depth}")(
+        num_classes=args.classes, s2d_stem=True, data_format=fmt)
+    opt = paddle.optimizer.Momentum(learning_rate=3e-3, momentum=0.9,
+                                    parameters=model.parameters())
+    model, opt = paddle.amp.decorate(models=model, optimizers=opt,
+                                     dtype="bfloat16",
+                                     master_weight=False)
+
+    def loss_fn(m, x, y):
+        return F.cross_entropy(m(x), y, reduction="mean")
+
+    step = paddle.jit.train_step(model, loss_fn, opt)
+
+    if args.data_dir:
+        from paddle.vision.datasets import ImageFolder  # PIL-decoded
+        from paddle.vision import transforms as T
+        tf = T.Compose([T.Resize((args.image_size, args.image_size)),
+                        T.ToTensor()])
+        ds = ImageFolder(args.data_dir, transform=tf)
+        from paddle.io import DataLoader
+        dl = DataLoader(ds, batch_size=args.batch, shuffle=True,
+                        num_workers=2)
+        it = iter(dl)
+
+    import numpy as np
+    s = args.image_size
+    rng = np.random.RandomState(0)
+    # learnable synthetic task: per-class mean images + noise
+    centers = rng.randn(args.classes, 3, s, s).astype(np.float32)
+    for i in range(args.steps):
+        if args.data_dir:
+            try:
+                x, y = next(it)
+            except StopIteration:
+                it = iter(dl)
+                x, y = next(it)
+            if fmt == "NHWC":
+                x = x.transpose([0, 2, 3, 1])
+        else:
+            lab = rng.randint(0, args.classes, args.batch)
+            img = centers[lab] + 0.5 * rng.randn(
+                args.batch, 3, s, s).astype(np.float32)
+            if fmt == "NHWC":
+                img = img.transpose(0, 2, 3, 1)
+            x = paddle.to_tensor(img).astype("bfloat16")
+            y = paddle.to_tensor(lab.astype(np.int64))
+        loss = step(x, y)
+        if i % 5 == 0:
+            print(f"step {i}: loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

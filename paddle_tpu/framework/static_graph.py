@@ -225,6 +225,7 @@ def record_op(name, fn, tensor_args, consts, result):
     # param-only chains (e.g. weight-standardization w * s) must stay
     # differentiable-to-the-real-parameter, not freeze into pseudo-leaves
     if not any(getattr(t, "_sym", None) is not None
+               or getattr(t, "_pending_creation", None) is not None
                or t.persistable or not t.stop_gradient
                for t in tensor_args):
         return
@@ -371,9 +372,11 @@ class Executor:
         for t in fetch_list:
             sym = getattr(t, "_sym", None)
             if (sym is None or sym[0].graph_id != prog.graph_id) and \
-                    getattr(t, "_pending_creation", None) is not None:
+                    getattr(t, "_pending_creation", None) is not None \
+                    and not t.persistable and t.stop_gradient:
                 # fetching a creation-RNG tensor that was never consumed
                 # by a recorded op: materialize it now so it re-draws
+                # (persistable/trainable state stays a live leaf)
                 sym = _materialize_creation(prog, t)
             if sym is None or sym[0].graph_id != prog.graph_id:
                 raise ValueError(

@@ -567,7 +567,8 @@ def _multinomial_k(x, key, num_samples=1, replacement=False):
     else:
         g = jax.random.gumbel(key, logits.shape)
         out = jnp.argsort(-(logits + g), axis=-1)[..., :num_samples]
-    return out.astype(jnp.int32)
+    from ..dtypes import convert_dtype, int64
+    return out.astype(convert_dtype(int64))
 
 
 register("multinomial_k", _multinomial_k)

@@ -143,7 +143,7 @@ def _rng_creation(name, maker):
     t = Tensor._from_array(maker(key))
     from .framework import static_graph as _sg
     if _sg.enabled():
-        _sg.record_rng_creation(name, lambda key, _m=maker: _m(key), key, t)
+        _sg.record_rng_creation(name, maker, key, t)
     return t
 
 

@@ -241,3 +241,34 @@ def test_creation_rng_rethreads_per_run():
     finally:
         pt.disable_static()
         sg.reset()
+
+
+def test_creation_rng_chains_and_persistable_buffers():
+    """Derived creation chains (bernoulli(uniform), randn*2) must stay
+    per-run random; persistable buffers built from randn must replay as
+    LIVE leaves (review-round regressions)."""
+    import numpy as np
+    import paddle_tpu as pt
+    import paddle_tpu.static as st
+    from paddle_tpu.framework import static_graph as sg
+
+    pt.enable_static()
+    try:
+        sg.reset()
+        x = st.data("x", [4], "float32")
+        m = pt.bernoulli(pt.uniform([4], min=0.3, max=0.7))
+        y = x + m
+        z = x + pt.randn([4]) * 2.0
+        buf = pt.randn([4])
+        buf.persistable = True
+        used = x + buf
+        exe = st.Executor()
+        feed = {"x": np.zeros(4, np.float32)}
+        y1, z1, b1 = exe.run(feed=feed, fetch_list=[y, z, used])
+        y2, z2, b2 = exe.run(feed=feed, fetch_list=[y, z, used])
+        assert not np.array_equal(np.asarray(y1), np.asarray(y2))
+        assert not np.array_equal(np.asarray(z1), np.asarray(z2))
+        np.testing.assert_array_equal(np.asarray(b1), np.asarray(b2))
+    finally:
+        pt.disable_static()
+        sg.reset()
