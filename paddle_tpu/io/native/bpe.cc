@@ -1,0 +1,90 @@
+// Native byte-level BPE encoder (reference analog: the reference
+// ecosystem's fast tokenizers are C++ — tokenizer travels with the model
+// zoo).  Greedy lowest-rank pair merging over byte sequences; the Python
+// BPETokenizer ships the merge-rank table once, then encodes word pieces
+// through this hot path.
+//
+// API (extern "C", ctypes-bound in bpe_native.py):
+//   bpe_new()                                   -> handle
+//   bpe_set_byte_id(h, byte, id)                   (256 base byte tokens)
+//   bpe_add_merge(h, left_id, right_id, merged_id, rank)
+//   bpe_encode_piece(h, text, len, out_ids, max_out) -> n_ids (-1 ovfl)
+//   bpe_free(h)
+//
+// Encoding walks GPT-2-style pre-token boundaries on the Python side;
+// this unit only merges within one piece, so the merge arrays stay tiny
+// and cache-resident.
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct PairHash {
+  size_t operator()(const std::pair<int32_t, int32_t>& p) const {
+    return (static_cast<size_t>(p.first) << 32) ^
+           static_cast<uint32_t>(p.second);
+  }
+};
+
+struct Bpe {
+  // token string -> id (only needed for the 256 byte tokens at encode
+  // time; longer tokens are reached through merges)
+  int32_t byte_ids[256];
+  std::unordered_map<std::pair<int32_t, int32_t>, std::pair<int32_t, int32_t>,
+                     PairHash>
+      merges;  // (l, r) -> (merged_id, rank)
+};
+
+}  // namespace
+
+extern "C" {
+
+void* bpe_new() {
+  Bpe* b = new Bpe();
+  for (int i = 0; i < 256; ++i) b->byte_ids[i] = -1;
+  return b;
+}
+
+void bpe_free(void* h) { delete static_cast<Bpe*>(h); }
+
+void bpe_set_byte_id(void* h, int32_t byte, int32_t id) {
+  static_cast<Bpe*>(h)->byte_ids[byte & 0xff] = id;
+}
+
+void bpe_add_merge(void* h, int32_t left, int32_t right, int32_t merged,
+                   int32_t rank) {
+  static_cast<Bpe*>(h)->merges[{left, right}] = {merged, rank};
+}
+
+// encode one pre-token (utf-8 bytes) -> ids; returns count or -1 overflow
+int64_t bpe_encode_piece(void* h, const uint8_t* text, int64_t n,
+                         int32_t* out, int64_t max_out) {
+  Bpe* b = static_cast<Bpe*>(h);
+  std::vector<int32_t> ids;
+  ids.reserve(n);
+  for (int64_t i = 0; i < n; ++i) ids.push_back(b->byte_ids[text[i]]);
+  // greedy: repeatedly merge the lowest-rank adjacent pair
+  while (ids.size() > 1) {
+    int32_t best_rank = INT32_MAX, best_i = -1, best_merged = 0;
+    for (size_t i = 0; i + 1 < ids.size(); ++i) {
+      auto it = b->merges.find({ids[i], ids[i + 1]});
+      if (it != b->merges.end() && it->second.second < best_rank) {
+        best_rank = it->second.second;
+        best_i = static_cast<int32_t>(i);
+        best_merged = it->second.first;
+      }
+    }
+    if (best_i < 0) break;
+    ids[best_i] = best_merged;
+    ids.erase(ids.begin() + best_i + 1);
+  }
+  if (static_cast<int64_t>(ids.size()) > max_out) return -1;
+  std::memcpy(out, ids.data(), ids.size() * sizeof(int32_t));
+  return static_cast<int64_t>(ids.size());
+}
+
+}  // extern "C"

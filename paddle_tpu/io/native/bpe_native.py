@@ -1,0 +1,88 @@
+"""ctypes binding for the native BPE encoder (bpe.cc).
+
+Graceful degradation like the ring: `available()` False (no compiler)
+keeps the pure-Python BPETokenizer path working.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+from . import build_so
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "bpe.cc")
+_SO = os.path.join(_DIR, "_bpe.so")
+
+LIB = None
+
+
+def _load():
+    path = build_so(_SRC, _SO)
+    try:
+        return ctypes.CDLL(path)
+    except OSError:
+        # stale/foreign-arch cached .so: force rebuild once (same retry
+        # as the sibling ring/imgproc bindings)
+        return ctypes.CDLL(build_so(_SRC, _SO, force=True))
+
+
+try:
+    LIB = _load()
+    LIB.bpe_new.restype = ctypes.c_void_p
+    LIB.bpe_free.argtypes = [ctypes.c_void_p]
+    LIB.bpe_set_byte_id.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                    ctypes.c_int32]
+    LIB.bpe_add_merge.argtypes = [ctypes.c_void_p] + [ctypes.c_int32] * 4
+    LIB.bpe_encode_piece.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
+    LIB.bpe_encode_piece.restype = ctypes.c_int64
+except Exception:  # pragma: no cover - no toolchain
+    LIB = None
+
+
+def available():
+    return LIB is not None
+
+
+class NativeBPE:
+    """Owns one C-side merge table mirroring a BPETokenizer."""
+
+    def __init__(self, vocab, merges):
+        # refuse inconsistent tables UP FRONT: the C side would emit -1
+        # ids / silently skip merges where the Python path raises
+        # KeyError loudly — the caller falls back to Python on raise
+        for b in range(256):
+            if bytes([b]).decode("latin-1") not in vocab:
+                raise ValueError(
+                    f"vocab missing base byte token {b} (not the latin-1 "
+                    f"byte-level convention); native path refused")
+        for left, right in merges:
+            if left not in vocab or right not in vocab \
+                    or (left + right) not in vocab:
+                raise ValueError(
+                    f"merge ({left!r}, {right!r}) unresolvable in vocab; "
+                    f"native path refused")
+        self._h = LIB.bpe_new()
+        for b in range(256):
+            LIB.bpe_set_byte_id(self._h, b,
+                                vocab[bytes([b]).decode("latin-1")])
+        for rank, (left, right) in enumerate(merges):
+            LIB.bpe_add_merge(self._h, vocab[left], vocab[right],
+                              vocab[left + right], rank)
+
+    def encode_piece(self, piece: str):
+        raw = piece.encode("utf-8")
+        # per-call buffer: ctypes drops the GIL during the C call, so a
+        # shared buffer would corrupt ids under concurrent encodes
+        buf = (ctypes.c_int32 * max(4096, len(raw) + 1))()
+        n = LIB.bpe_encode_piece(self._h, raw, len(raw), buf, len(buf))
+        if n < 0:  # pragma: no cover - defensive
+            return None
+        return list(buf[:n])
+
+    def __del__(self):
+        if LIB is not None and getattr(self, "_h", None):
+            LIB.bpe_free(self._h)
+            self._h = None

@@ -40,6 +40,14 @@ class BPETokenizer:
         self._ranks = {m: i for i, m in enumerate(self.merges)}
         self._inv = {i: t for t, i in self.vocab.items()}
         self._cache = {}
+        # native hot path (io/native/bpe.cc); None -> pure Python
+        self._native = None
+        try:
+            from ..io.native import bpe_native
+            if bpe_native.available() and self.vocab:
+                self._native = bpe_native.NativeBPE(self.vocab, self.merges)
+        except Exception:  # pragma: no cover
+            self._native = None
 
     # ------------------------------------------------------------ training
     @classmethod
@@ -92,6 +100,11 @@ class BPETokenizer:
     def _bpe(self, token):
         if token in self._cache:
             return self._cache[token]
+        if self._native is not None:
+            out = self._native.encode_piece(token)
+            if out is not None:
+                self._cache[token] = out
+                return out
         parts = list(_to_bytes_tokens(token))
         while len(parts) > 1:
             best, best_rank = None, None

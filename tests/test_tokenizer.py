@@ -87,3 +87,17 @@ def test_lm_dataset_end_to_end_training(tmp_path):
                 first = float(loss)
             last = float(loss)
     assert last < first - 0.5, (first, last)
+
+
+def test_native_bpe_matches_python():
+    """io/native/bpe.cc encode == pure-Python encode, exactly."""
+    from paddle_tpu.io.native import bpe_native
+    if not bpe_native.available():
+        pytest.skip("native toolchain unavailable")
+    tok = BPETokenizer.train([CORPUS], vocab_size=400)
+    assert tok._native is not None
+    tok_py = BPETokenizer(tok.vocab, tok.merges, tok.special_tokens)
+    tok_py._native = None
+    for s in (CORPUS[:500], "Hello, WORLD!! 123", "héllo ☃ 你好",
+              "tabs\tand\nnewlines", "a<|endoftext|>b"):
+        assert tok.encode(s) == tok_py.encode(s), s
