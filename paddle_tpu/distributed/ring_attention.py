@@ -19,9 +19,24 @@ from jax.sharding import PartitionSpec as P
 
 
 def _block_attn(q, k, v, scale, qpos, kpos, causal):
-    """One KV-block contribution. q:[B,Lq,H,D] k,v:[B,Lk,H,D].
-    Returns (o_partial [B,Lq,H,D], m [B,H,Lq], l [B,H,Lq]) un-normalized."""
-    s = jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32) * scale
+    """One KV-block contribution. q:[B,Lq,H,D] k,v:[B,Lk,Hkv,D] with
+    H % Hkv == 0 (GQA: grouped einsums, repeat_interleave head mapping —
+    the UNREPEATED kv is what rides the ring, so grouping costs no extra
+    ICI traffic).  Returns (o_partial [B,Lq,H,D], m [B,H,Lq], l [B,H,Lq])
+    un-normalized."""
+    B, Lq, H, D = q.shape
+    Lk, Hkv = k.shape[1], k.shape[2]
+    if Hkv == 0 or H % Hkv:
+        raise ValueError(
+            f"ring attention GQA needs q heads ({H}) divisible by kv "
+            f"heads ({Hkv})")
+    if H == Hkv:
+        s = jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32) * scale
+    else:
+        g = H // Hkv
+        q5 = q.reshape(B, Lq, Hkv, g, D)
+        s = jnp.einsum("blkgd,bmkd->bkglm", q5, k).astype(
+            jnp.float32).reshape(B, H, Lq, Lk) * scale
     if causal:
         mask = kpos[None, :] <= qpos[:, None]  # [Lq, Lk]
         s = jnp.where(mask[None, None], s, -jnp.inf)
@@ -31,7 +46,12 @@ def _block_attn(q, k, v, scale, qpos, kpos, causal):
     p = jnp.exp(s - m_safe[..., None])
     p = jnp.where(jnp.isneginf(s), 0.0, p)
     l = jnp.sum(p, axis=-1)                                   # [B,H,Lq]
-    o = jnp.einsum("bhlm,bmhd->blhd", p.astype(v.dtype), v)
+    if H == Hkv:
+        o = jnp.einsum("bhlm,bmhd->blhd", p.astype(v.dtype), v)
+    else:
+        p5 = p.reshape(B, Hkv, g, Lq, Lk)
+        o = jnp.einsum("bkglm,bmkd->blkgd", p5.astype(v.dtype),
+                       v).reshape(B, Lq, H, D)
     return o, m_safe, l, jnp.isneginf(m)
 
 

@@ -1,0 +1,66 @@
+"""Ring attention with GQA heads (round 3): grouped kv must equal full
+attention with repeat_interleave'd heads — the unrepeated kv rides the
+ring."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.ring_attention import ring_attention
+from paddle_tpu.ops.nn_kernels import sdpa_k
+
+
+@pytest.fixture
+def mesh_sp4():
+    prev = dict(mesh_mod._state)
+    yield mesh_mod.build_mesh(dp=1, pp=1, mp=4)
+    mesh_mod._state.update(prev)
+
+
+def test_ring_gqa_matches_full(mesh_sp4):
+    mesh = mesh_sp4
+    rng = np.random.default_rng(0)
+    B, L, H, Hkv, D = 2, 32, 8, 2, 16
+    q = jnp.asarray(rng.standard_normal((B, L, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, L, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, L, Hkv, D)), jnp.float32)
+    for causal in (True, False):
+        out = ring_attention(q, k, v, mesh=mesh, axis_name="mp",
+                             causal=causal)
+        kr = jnp.repeat(k, H // Hkv, axis=2)
+        vr = jnp.repeat(v, H // Hkv, axis=2)
+        ref = sdpa_k(q, kr, vr, is_causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_ring_gqa_grads(mesh_sp4):
+    mesh = mesh_sp4
+    rng = np.random.default_rng(1)
+    B, L, H, Hkv, D = 1, 16, 4, 2, 8
+    q = jnp.asarray(rng.standard_normal((B, L, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, L, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, L, Hkv, D)), jnp.float32)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(jnp.sin(ring_attention(q, k, v, mesh=mesh,
+                                              axis_name="mp")))
+
+    def loss_ref(q, k, v):
+        kr = jnp.repeat(k, H // Hkv, axis=2)
+        vr = jnp.repeat(v, H // Hkv, axis=2)
+        return jnp.sum(jnp.sin(sdpa_k(q, kr, vr, is_causal=True)))
+
+    g1 = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_ring_gqa_bad_heads_clear_error(mesh_sp4):
+    q = jnp.zeros((1, 8, 8, 4), jnp.float32)
+    k = jnp.zeros((1, 8, 3, 4), jnp.float32)
+    with pytest.raises(ValueError, match="divisible"):
+        ring_attention(q, k, k, mesh=mesh_sp4, axis_name="mp")
