@@ -88,7 +88,8 @@ def pipeline_apply(stage_fn, stacked_params, x_microbatched, mesh,
     return out[0]
 
 
-def gpipe_hybrid(block_apply, n_stages, n_microbatches, axis_name="pp"):
+def gpipe_hybrid(block_apply, n_stages, n_microbatches, axis_name="pp",
+                 mutable_bufs=False):
     """GPipe schedule as a *partial-manual* shard_map body: manual over the
     "pp" mesh axis only, leaving "dp"/"mp" to GSPMD inside the body — so
     tensor-parallel param annotations and dp batch sharding keep working
@@ -108,24 +109,9 @@ def gpipe_hybrid(block_apply, n_stages, n_microbatches, axis_name="pp"):
     the fleet engine always calls this inside its pjit'd step.
     """
 
-    def stage_fn(stage_params, x, key):
-        n_layers = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
-
-        def scan_block(carry, xs):
-            h, aux = carry
-            layer_params, li = xs
-            k = jax.random.fold_in(key, li)
-            y, a = block_apply(layer_params, h, k)
-            return (y, aux + a), None
-
-        (y, aux), _ = lax.scan(scan_block,
-                               (x, jnp.zeros((), jnp.float32)),
-                               (stage_params, jnp.arange(n_layers)))
-        return y, aux
-
     def pipelined(stacked_params, x_mb, key):
         # under shard_map the pp axis is manual: leading dim == 1 here
-        my_params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+        my_params, my_bufs = _device_tree(stacked_params, mutable_bufs)
         idx = lax.axis_index(axis_name)
         P_, M = n_stages, n_microbatches
         T = M + P_ - 1
@@ -137,14 +123,19 @@ def gpipe_hybrid(block_apply, n_stages, n_microbatches, axis_name="pp"):
         aux_acc = jnp.zeros((), jnp.float32)
 
         def body(carry, t):
-            state, out_buf, aux_acc = carry
+            state, out_buf, aux_acc, bstack = carry
             inject = x_mb[jnp.clip(t, 0, M - 1)]
             cur = jnp.where(idx == 0, inject, state)
-            y, aux = stage_fn(my_params, cur, jax.random.fold_in(key, t))
+            y, aux, bnew = _stage_scan(block_apply, my_params, cur,
+                                       jax.random.fold_in(key, t), bstack)
             # stage idx holds microbatch t-idx at step t: only those
             # steps' aux are real work (bubble steps chew zeros/garbage)
             active = (t >= idx) & (t < idx + M)
             aux_acc = aux_acc + jnp.where(active, aux, 0.0)
+            # buffer updates (BN running stats) commit per ACTIVE
+            # microbatch in order — serial semantics
+            bstack = {n: jnp.where(active, bnew[n], bstack[n])
+                      for n in bstack}
             emit_t = jnp.clip(t - (P_ - 1), 0, M - 1)
             is_emit = (t >= P_ - 1) & (idx == P_ - 1)
             prev = lax.dynamic_index_in_dim(out_buf, emit_t, 0,
@@ -153,14 +144,18 @@ def gpipe_hybrid(block_apply, n_stages, n_microbatches, axis_name="pp"):
             out_buf = lax.dynamic_update_index_in_dim(out_buf, upd, emit_t, 0)
             perm = [(i, (i + 1) % P_) for i in range(P_)]
             state = lax.ppermute(y, axis_name, perm)
-            return (state, out_buf, aux_acc), None
+            return (state, out_buf, aux_acc, bstack), None
 
-        (state, out_buf, aux_acc), _ = lax.scan(
-            body, (state, out_buf, aux_acc), jnp.arange(T))
+        (state, out_buf, aux_acc, bstack), _ = lax.scan(
+            body, (state, out_buf, aux_acc, my_bufs), jnp.arange(T))
         out = lax.psum(
             jnp.where(idx == P_ - 1, out_buf,
                       jnp.zeros_like(out_buf)), axis_name)
         aux_total = lax.psum(aux_acc, axis_name)
+        if mutable_bufs:
+            return (out[None], aux_total,
+                    {n: lax.stop_gradient(b)[None]
+                     for n, b in bstack.items()})
         return out[None], aux_total
 
     return pipelined
@@ -289,26 +284,289 @@ def interleaved_hybrid(block_apply, n_stages, n_microbatches, n_chunks,
     return pipelined
 
 
+def _split_bufs(tree):
+    """Split a stacked leaf dict into (trainable rows, 'buf::' buffers).
+    Non-dict trees have no buffer convention — everything is a param."""
+    if not isinstance(tree, dict):
+        return tree, {}
+    return ({n: v for n, v in tree.items() if not n.startswith("buf::")},
+            {n: v for n, v in tree.items() if n.startswith("buf::")})
+
+
+def _device_tree(stacked_params, mutable_bufs):
+    """Per-device view of the stacked tree (leading pp dim squeezed under
+    shard_map) split into (params, buffer stacks) — buffers only separate
+    when the schedule threads them (mutable_bufs)."""
+    my_all = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+    if not mutable_bufs:
+        return my_all, {}
+    return _split_bufs(my_all)
+
+
+def _stage_scan(block_apply, stage_params, x, key_m, bufs=None):
+    """One device's layers on one microbatch; per-layer key folded from the
+    MICROBATCH key (not the schedule step) so the 1F1B backward can replay
+    the exact forward randomness during recompute.
+
+    bufs: optional {'buf::name': [lps, ...]} stack threaded as a carry —
+    each layer reads its row and may return an updated row (train-mode BN
+    running stats), which is written back so the NEXT microbatch on this
+    device sees it (serial per-microbatch semantics).  Returns
+    (y, aux, new_bufs)."""
+    bufs = bufs or {}
+    leaves = jax.tree_util.tree_leaves(stage_params)
+    n_layers = (leaves or jax.tree_util.tree_leaves(bufs))[0].shape[0]
+
+    def scan_block(carry, xs):
+        h, aux, bstack = carry
+        layer_params, li = xs
+        row = {n: lax.dynamic_index_in_dim(b, li, 0, keepdims=False)
+               for n, b in bstack.items()}
+        out = block_apply({**layer_params, **row} if row else layer_params,
+                          h, jax.random.fold_in(key_m, li))
+        if len(out) == 3:
+            y, a, newb = out
+            if newb:
+                bstack = {n: lax.dynamic_update_index_in_dim(
+                    bstack[n], newb[n].astype(bstack[n].dtype), li, 0)
+                    for n in bstack}
+        else:
+            y, a = out
+        return (y, aux + a, bstack), None
+
+    (y, aux, bstack), _ = lax.scan(
+        scan_block, (x, jnp.zeros((), jnp.float32), bufs),
+        (stage_params, jnp.arange(n_layers)))
+    return y, aux, bstack
+
+
+def onef1b_pipeline(block_apply, mesh, n_stages, n_microbatches,
+                    axis_name="pp", mutable_bufs=False):
+    """1F1B-memory pipeline schedule (reference: fleet/meta_parallel/
+    pipeline_parallel.py's 1F1B) as a hand-written two-scan custom_vjp.
+
+    Why: differentiating the GPipe scan (gpipe_hybrid + jax.grad) makes
+    jax save the scan CARRY at every schedule step — out_buf alone is
+    [M, mb] x (M+P-1) steps — which measured 2.25x the 1F1B analytic
+    activation budget (docs/pp_memory.md).  1F1B's insight is that only
+    O(P) microbatch activations need to be live per device.  Under SPMD
+    remat we do one better: the forward scan stores ONLY the per-microbatch
+    stage-boundary inputs ([M, mb] per device — no x12 per-layer internals,
+    no per-step carries), and the hand-written backward pipeline scan
+    recomputes each stage on the fly with jax.vjp, holding one stage's
+    internals transiently.  Peak activation residency is M boundary acts +
+    one stage's recompute internals — below even the P-microbatch 1F1B
+    budget for realistic configs.
+
+    Schedule: forward = GPipe fwd wave (device p runs microbatch m at step
+    m+p); backward = mirrored wave (device p runs bwd(m) at step
+    m + P-1-p), grads riding the reverse ring.  Each wave is bubble-optimal
+    for its direction; total schedule length 2(M+P-1) matches 1F1B's.
+
+    Returns apply(stacked_params, x_mb, key) -> (out [M, mb, ...],
+    aux_total) — same contract as pipeline_apply_hybrid, differentiable
+    wrt stacked_params and x_mb via the custom rules.
+    """
+    P_, M = n_stages, n_microbatches
+    perm_fwd = [(i, (i + 1) % P_) for i in range(P_)]
+    perm_rev = [(i, (i - 1) % P_) for i in range(P_)]
+
+    def fwd_device(stacked_params, x_mb, key):
+        my_params, my_bufs = _device_tree(stacked_params, mutable_bufs)
+        idx = lax.axis_index(axis_name)
+        key_d = jax.random.fold_in(key, idx)
+        mb_shape = x_mb.shape[1:]
+        T = M + P_ - 1
+
+        out_buf = jnp.zeros((M,) + mb_shape, x_mb.dtype)
+        in_store = jnp.zeros((M,) + mb_shape, x_mb.dtype)
+        state = jnp.zeros(mb_shape, x_mb.dtype)
+        aux_acc = jnp.zeros((), jnp.float32)
+
+        def body(carry, t):
+            state, out_buf, in_store, aux_acc, bstack = carry
+            m = jnp.clip(t - idx, 0, M - 1)
+            active = (t >= idx) & (t < idx + M)
+            inject = x_mb[jnp.clip(t, 0, M - 1)]
+            cur = jnp.where(idx == 0, inject, state)
+            # the ONLY activation saved for backward: this stage's input
+            prev = lax.dynamic_index_in_dim(in_store, m, 0, keepdims=False)
+            in_store = lax.dynamic_update_index_in_dim(
+                in_store, jnp.where(active, cur, prev), m, 0)
+            y, aux, bnew = _stage_scan(block_apply, my_params, cur,
+                                       jax.random.fold_in(key_d, m),
+                                       bstack)
+            aux_acc = aux_acc + jnp.where(active, aux, 0.0)
+            bstack = {n: jnp.where(active, bnew[n], bstack[n])
+                      for n in bstack}
+            emit_t = jnp.clip(t - (P_ - 1), 0, M - 1)
+            is_emit = (t >= P_ - 1) & (idx == P_ - 1)
+            prev_o = lax.dynamic_index_in_dim(out_buf, emit_t, 0,
+                                              keepdims=False)
+            out_buf = lax.dynamic_update_index_in_dim(
+                out_buf, jnp.where(is_emit, y, prev_o), emit_t, 0)
+            state = lax.ppermute(y, axis_name, perm_fwd)
+            return (state, out_buf, in_store, aux_acc, bstack), None
+
+        (state, out_buf, in_store, aux_acc, bstack), _ = lax.scan(
+            body, (state, out_buf, in_store, aux_acc, my_bufs),
+            jnp.arange(T))
+        out = lax.psum(
+            jnp.where(idx == P_ - 1, out_buf, jnp.zeros_like(out_buf)),
+            axis_name)
+        aux_total = lax.psum(aux_acc, axis_name)
+        new_bufs = {n: b[None] for n, b in bstack.items()}
+        return out[None], aux_total, in_store[None], new_bufs
+
+    def bwd_device(stacked_params, in_store, key, dy, daux):
+        my_params, my_bufs = _device_tree(stacked_params, mutable_bufs)
+        in_store = in_store[0]
+        idx = lax.axis_index(axis_name)
+        key_d = jax.random.fold_in(key, idx)
+        mb_shape = dy.shape[1:]
+        skew = P_ - 1 - idx     # bwd(m) runs on this device at step skew+m
+        T = M + P_ - 1
+
+        gacc = jax.tree_util.tree_map(jnp.zeros_like, my_params)
+        dx_buf = jnp.zeros((M,) + mb_shape, dy.dtype)
+        gstate = jnp.zeros(mb_shape, dy.dtype)
+
+        def body(carry, s):
+            gstate, gacc, dx_buf = carry
+            m = jnp.clip(s - skew, 0, M - 1)
+            active = (s >= skew) & (s < skew + M)
+            g_in = jnp.where(idx == P_ - 1, dy[m], gstate)
+            x_in = lax.dynamic_index_in_dim(in_store, m, 0, keepdims=False)
+
+            def f(params, x):
+                # recompute with the PRE-schedule buffers: sound because
+                # pipelined buffer mutation is restricted to write-only
+                # accumulators (BN running stats), whose values never feed
+                # the block outputs in train mode
+                y, aux, _ = _stage_scan(block_apply, params, x,
+                                        jax.random.fold_in(key_d, m),
+                                        my_bufs)
+                return y, aux
+
+            (y, _aux), vjp_fn = jax.vjp(f, my_params, x_in)
+            dparams, dx = vjp_fn((g_in.astype(y.dtype),
+                                  jnp.where(active, daux,
+                                            0.0).astype(jnp.float32)))
+            # bubble lanes vjp garbage — masked out of every accumulator
+            gacc = jax.tree_util.tree_map(
+                lambda a, d: a + jnp.where(active, d, 0.0).astype(a.dtype),
+                gacc, dparams)
+            prev_dx = lax.dynamic_index_in_dim(dx_buf, m, 0, keepdims=False)
+            dx_buf = lax.dynamic_update_index_in_dim(
+                dx_buf, jnp.where(active & (idx == 0),
+                                  dx.astype(dx_buf.dtype), prev_dx), m, 0)
+            gstate = lax.ppermute(jnp.where(active, dx, 0.0), axis_name,
+                                  perm_rev)
+            return (gstate, gacc, dx_buf), None
+
+        (gstate, gacc, dx_buf), _ = lax.scan(
+            body, (gstate, gacc, dx_buf), jnp.arange(T))
+        # dL/dx_mb is stage 0's dx wave; replicate it (x_mb rode in P())
+        dx_mb = lax.psum(
+            jnp.where(idx == 0, dx_buf, jnp.zeros_like(dx_buf)), axis_name)
+        if my_bufs:    # buffers are non-differentiable: zero cotangents
+            gacc = {**gacc,
+                    **{n: jnp.zeros_like(b) for n, b in my_bufs.items()}}
+        return jax.tree_util.tree_map(lambda g: g[None], gacc), dx_mb
+
+    param_specs_of = lambda tree: jax.tree_util.tree_map(
+        lambda _: P(axis_name), tree)
+
+    def make(stacked_params):
+        pspecs = param_specs_of(stacked_params)
+        buf_specs = {}
+        if mutable_bufs and isinstance(stacked_params, dict):
+            buf_specs = {n: P(axis_name) for n in stacked_params
+                         if n.startswith("buf::")}
+        fwd_mapped = jax.shard_map(
+            fwd_device, mesh=mesh, in_specs=(pspecs, P(), P()),
+            out_specs=(P(axis_name), P(), P(axis_name), buf_specs),
+            axis_names={axis_name}, check_vma=False)
+        bwd_mapped = jax.shard_map(
+            bwd_device, mesh=mesh,
+            in_specs=(pspecs, P(axis_name), P(), P(), P()),
+            out_specs=(pspecs, P()),
+            axis_names={axis_name}, check_vma=False)
+
+        @jax.custom_vjp
+        def apply(stacked, x_mb, key):
+            out, aux, _, new_bufs = fwd_mapped(stacked, x_mb, key)
+            return out[0], aux, new_bufs
+
+        def apply_fwd(stacked, x_mb, key):
+            out, aux, in_store, new_bufs = fwd_mapped(stacked, x_mb, key)
+            return (out[0], aux, new_bufs), (stacked, in_store, key)
+
+        def apply_bwd(res, cots):
+            stacked, in_store, key = res
+            dy, daux, _dbufs = cots   # buffer outputs are non-diff
+            dstacked, dx_mb = bwd_mapped(stacked, in_store, key, dy, daux)
+            import numpy as np
+            dkey = np.zeros(np.shape(key), jax.dtypes.float0)
+            return dstacked, dx_mb, dkey
+
+        apply.defvjp(apply_fwd, apply_bwd)
+        return apply
+
+    return make
+
+
+def pipeline_apply_1f1b(block_apply, stacked_params, x_mb, key, mesh,
+                        n_stages, n_microbatches, axis_name="pp",
+                        mutable_bufs=False):
+    """1F1B-memory schedule entry point; drop-in for pipeline_apply_hybrid
+    (n_chunks=1).  Must be called inside jit (partial-manual shard_map).
+    With mutable_bufs, returns (out, aux_total, new_stacked_bufs) where
+    new_stacked_bufs are the schedule's committed 'buf::' leaf updates
+    (BN running stats); otherwise (out, aux_total)."""
+    make = onef1b_pipeline(block_apply, mesh, n_stages, n_microbatches,
+                           axis_name, mutable_bufs=mutable_bufs)
+    out, aux, new_bufs = make(stacked_params)(stacked_params, x_mb, key)
+    if mutable_bufs:
+        return out, aux, new_bufs
+    return out, aux
+
+
 def pipeline_apply_hybrid(block_apply, stacked_params, x_mb, key, mesh,
                           n_stages, n_microbatches, axis_name="pp",
-                          n_chunks=1):
+                          n_chunks=1, mutable_bufs=False):
     """Run the hybrid pipeline schedule (GPipe, or interleaved when
     n_chunks > 1); must be called inside jit (the fleet engine's pjit
     step).  x_mb: [M, mb, ...]; returns ([M, mb, ...], aux_total) where
     aux_total sums block aux losses (MoE routers) over all stages and
-    microbatches."""
+    microbatches.  mutable_bufs (GPipe only): returns a third output —
+    the committed 'buf::' stacked updates (BN running stats)."""
     if n_chunks > 1:
+        if mutable_bufs:
+            raise NotImplementedError(
+                "mutable block buffers are not supported by the "
+                "interleaved (n_chunks > 1) schedule — use n_chunks=1 "
+                "(GPipe/1F1B) for BN-bearing pipelined blocks")
         fn = interleaved_hybrid(block_apply, n_stages, n_microbatches,
                                 n_chunks, axis_name)
     else:
-        fn = gpipe_hybrid(block_apply, n_stages, n_microbatches, axis_name)
+        fn = gpipe_hybrid(block_apply, n_stages, n_microbatches, axis_name,
+                          mutable_bufs=mutable_bufs)
     param_specs = jax.tree_util.tree_map(
         lambda _: P(axis_name), stacked_params)
+    out_specs = (P(axis_name), P())
+    if mutable_bufs:
+        out_specs = out_specs + ({n: P(axis_name) for n in stacked_params
+                                  if n.startswith("buf::")},)
     mapped = jax.shard_map(fn, mesh=mesh,
                            in_specs=(param_specs, P(), P()),
-                           out_specs=(P(axis_name), P()),
+                           out_specs=out_specs,
                            axis_names={axis_name}, check_vma=False)
-    out, aux = mapped(stacked_params, x_mb, key)
+    res = mapped(stacked_params, x_mb, key)
+    if mutable_bufs:
+        out, aux, new_bufs = res
+        return out[0], aux, new_bufs
+    out, aux = res
     return out[0], aux
 
 

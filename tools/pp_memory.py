@@ -56,13 +56,16 @@ def main():
     remat_budget = M * act_bytes + lps * per_layer_acts * act_bytes
 
     rows = []
-    for remat, vpp in ((False, 1), (True, 1), (True, 2)):
+    for remat, vpp, sched in ((False, 1, "F-then-B"), (True, 1, "F-then-B"),
+                              (True, 2, "F-then-B"), (False, 1, "1F1B"),
+                              (True, 1, "1F1B")):
         if vpp > 1 and (M < P or lps % vpp):
             continue
         strategy = fleet.DistributedStrategy()
         strategy.hybrid_configs = {
             "dp_degree": 1, "mp_degree": 1, "pp_degree": P,
-            "accumulate_steps": M, "virtual_pp_degree": vpp}
+            "accumulate_steps": M, "virtual_pp_degree": vpp,
+            "pp_schedule": sched}
         fleet.init(is_collective=True, strategy=strategy)
         pt.seed(0)
         cfg = GPTConfig(
@@ -77,7 +80,68 @@ def main():
         step = fleet.build_train_step(m, gpt_loss_fn, opt)
         ids = pt.randint(0, args.vocab, [args.batch, args.seq])
         ms = step.memory_stats(ids, ids)
-        rows.append((remat, vpp, ms))
+        rows.append((remat, vpp, sched, ms))
+
+    # ---- pipeline-REGION-only measurement (apples-to-apples with the
+    # analytic activation budgets, which count only the pipelined blocks:
+    # the full-step numbers above also carry logits/CE/optimizer temps
+    # shared by every schedule)
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as PS
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.pipeline import (pipeline_apply_1f1b,
+                                                 pipeline_apply_hybrid)
+    mesh = mesh_mod.get_mesh()
+    H, S, nheads = args.hidden, args.seq, args.heads
+    lps_ = args.layers // P
+
+    def block(params, h, key):
+        # transformer-block-shaped compute: attn (qkv+proj) + 2-layer mlp
+        hn = (h - h.mean(-1, keepdims=True)) / (
+            h.std(-1, keepdims=True) + 1e-5)
+        qkv = hn @ params["wqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        B_, L_, _ = q.shape
+        hd = H // nheads
+        q = q.reshape(B_, L_, nheads, hd)
+        k = k.reshape(B_, L_, nheads, hd)
+        v = v.reshape(B_, L_, nheads, hd)
+        s = jnp.einsum("blhd,bmhd->bhlm", q, k) / (hd ** 0.5)
+        mask = jnp.tril(jnp.ones((L_, L_), bool))
+        s = jnp.where(mask[None, None], s, -1e9)
+        a = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhlm,bmhd->blhd", a, v).reshape(B_, L_, H)
+        h = h + o @ params["wo"]
+        hn2 = (h - h.mean(-1, keepdims=True)) / (
+            h.std(-1, keepdims=True) + 1e-5)
+        h = h + jax.nn.gelu(hn2 @ params["w1"]) @ params["w2"]
+        return h, jnp.zeros((), jnp.float32)
+
+    k0 = jax.random.PRNGKey(0)
+    shapes = {"wqkv": (H, 3 * H), "wo": (H, H), "w1": (H, 4 * H),
+              "w2": (4 * H, H)}
+    stacked = {n: 0.02 * jax.random.normal(
+        jax.random.fold_in(k0, i), (P, lps_) + sh, jnp.float32)
+        for i, (n, sh) in enumerate(shapes.items())}
+    x_mb = jax.random.normal(jax.random.fold_in(k0, 99),
+                             (M, mb, S, H), jnp.float32)
+
+    region_rows = []
+    for sched in ("F-then-B", "1F1B"):
+        def loss(stacked_, x_, key_):
+            if sched == "1F1B":
+                y, aux = pipeline_apply_1f1b(
+                    jax.checkpoint(block), stacked_, x_, key_, mesh,
+                    n_stages=P, n_microbatches=M)
+            else:
+                y, aux = pipeline_apply_hybrid(
+                    jax.checkpoint(block), stacked_, x_, key_, mesh,
+                    n_stages=P, n_microbatches=M, n_chunks=1)
+            return jnp.sum(y * y) + aux
+
+        g = jax.jit(jax.grad(loss))
+        ms = g.lower(stacked, x_mb, k0).compile().memory_analysis()
+        region_rows.append((sched, ms))
 
     print(f"# pp peak-memory evidence  "
           f"(L{args.layers} H{args.hidden} S{args.seq} B{args.batch} "
@@ -86,16 +150,25 @@ def main():
     print(f"  GPipe (hold all M mb):      {gpipe_budget:>14,}")
     print(f"  1F1B (hold P mb):           {f1b_budget:>14,}")
     print(f"  remat'd scan (boundaries):  {remat_budget:>14,}\n")
-    print("| remat | vpp | temp bytes | args bytes | out bytes |")
-    print("|---|---|---|---|---|")
-    for remat, vpp, ms in rows:
-        print(f"| {remat} | {vpp} | {ms.temp_size_in_bytes:,} "
+    print("| schedule | remat | vpp | temp bytes | args bytes | out bytes |")
+    print("|---|---|---|---|---|---|")
+    for remat, vpp, sched, ms in rows:
+        print(f"| {sched} | {remat} | {vpp} | {ms.temp_size_in_bytes:,} "
               f"| {ms.argument_size_in_bytes:,} "
               f"| {ms.output_size_in_bytes:,} |")
-    base = rows[0][2].temp_size_in_bytes
-    for remat, vpp, ms in rows[1:]:
-        print(f"\nremat={remat} vpp={vpp}: temp = "
-              f"{ms.temp_size_in_bytes / base:.2%} of non-remat GPipe")
+    base = rows[0][3].temp_size_in_bytes
+    for remat, vpp, sched, ms in rows[1:]:
+        print(f"\n{sched} remat={remat} vpp={vpp}: temp = "
+              f"{ms.temp_size_in_bytes / base:.2%} of non-remat GPipe, "
+              f"{ms.temp_size_in_bytes / f1b_budget:.2%} of the 1F1B "
+              f"analytic budget")
+    print("\npipeline REGION only (blocks fwd+bwd, no embed/head/optimizer"
+          " — the part the analytic budgets describe):\n")
+    print("| schedule | temp bytes | vs 1F1B analytic budget |")
+    print("|---|---|---|")
+    for sched, ms in region_rows:
+        print(f"| {sched} | {ms.temp_size_in_bytes:,} "
+              f"| {ms.temp_size_in_bytes / f1b_budget:.2%} |")
 
 
 if __name__ == "__main__":
