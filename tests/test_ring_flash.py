@@ -1,0 +1,92 @@
+"""Ring attention x pallas flash fusion (VERDICT r3 item 5; reference
+analog: paddle incubate RingFlashAttention over NCCL send/recv).
+
+Per KV-ring step the pallas flash kernel computes one normalized block
+(o, lse); blocks merge by log-sum-exp.  Backward reuses the flash
+backward with the GLOBAL lse — each step's (dq, dk, dv) are exact
+partials and (dk, dv) ride the ring with their kv shard.  CI runs the
+kernels in interpret mode on the virtual CPU mesh (the mosaic compile is
+exercised on-chip by the bench probe)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from paddle_tpu.distributed.ring_attention import ring_attention
+
+
+def _mesh(n=4):
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"needs {n} devices")
+    return Mesh(np.array(devs[:n]), ("mp",))
+
+
+def _full_ref(q, k, v, causal):
+    B, L, H, D = q.shape
+    Hkv = k.shape[2]
+    kk, vv = k, v
+    if Hkv != H:
+        g = H // Hkv
+        kk = jnp.repeat(k, g, axis=2)
+        vv = jnp.repeat(v, g, axis=2)
+    s = jnp.einsum("blhd,bmhd->bhlm", q, kk).astype(jnp.float32) / (D**0.5)
+    if causal:
+        m = jnp.tril(jnp.ones((L, L), bool))
+        s = jnp.where(m[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhlm,bmhd->blhd", p.astype(v.dtype), vv)
+
+
+def _qkv(H, Hkv, B=2, L=128, D=16, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (jax.random.normal(ks[0], (B, L, H, D), jnp.float32),
+            jax.random.normal(ks[1], (B, L, Hkv, D), jnp.float32),
+            jax.random.normal(ks[2], (B, L, Hkv, D), jnp.float32))
+
+
+@pytest.mark.parametrize("H,Hkv,causal", [(4, 4, True), (4, 4, False),
+                                          (8, 2, True), (8, 4, False)])
+def test_ring_flash_matches_full_attention(H, Hkv, causal):
+    mesh = _mesh()
+    q, k, v = _qkv(H, Hkv)
+    ref = _full_ref(q, k, v, causal)
+    out = jax.jit(lambda a, b, c: ring_attention(
+        a, b, c, mesh=mesh, causal=causal, impl="interpret"))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("H,Hkv,causal", [(4, 4, True), (8, 2, True),
+                                          (4, 4, False)])
+def test_ring_flash_grads_match_full_attention(H, Hkv, causal):
+    mesh = _mesh()
+    q, k, v = _qkv(H, Hkv, seed=3)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(_full_ref(q, k, v, causal)))
+
+    def loss_ring(q, k, v):
+        return jnp.sum(jnp.sin(ring_attention(
+            q, k, v, mesh=mesh, causal=causal, impl="interpret")))
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    for a, b, name in zip(gr, gf, "q k v".split()):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=3e-4, atol=3e-5,
+                                   err_msg=f"d{name}")
+
+
+def test_ring_flash_matches_einsum_ring_path():
+    """The two ring implementations (einsum streaming-softmax vs pallas
+    per-step kernel) must agree exactly — same math, different engines."""
+    mesh = _mesh()
+    q, k, v = _qkv(8, 2, seed=5)
+    a = jax.jit(lambda *t: ring_attention(*t, mesh=mesh, causal=True,
+                                          impl="einsum"))(q, k, v)
+    b = jax.jit(lambda *t: ring_attention(*t, mesh=mesh, causal=True,
+                                          impl="interpret"))(q, k, v)
+    np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                               rtol=2e-5, atol=2e-5)
