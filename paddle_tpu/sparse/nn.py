@@ -1,13 +1,19 @@
 """paddle.sparse.nn (reference: python/paddle/sparse/nn — sparse conv /
 BN / activation layers for point-cloud workloads).
 
-Correctness-first TPU backing: Conv3D/SubmConv3D compute through the
-dense XLA conv on the densified input and re-sparsify the result (output
-pattern from the occupancy mask; submanifold keeps the input pattern) —
-exactly the dense-masking semantics the reference kernels implement with
-gather/scatter.  This keeps forward+grad parity on TPU; a gather-based
-pallas path for large point clouds is future work, documented in
-docs/api_coverage.md.
+TPU backing (round 4):
+  * SubmConv3D is REAL sparse compute — gather -> matmul -> scatter over
+    the BCOO indices with compute proportional to nnz: unique active
+    sites found by sort/searchsorted on linearized coordinates, neighbor
+    rows gathered per kernel offset, and ONE stacked einsum
+    ("ksi,kio->so") contracts all K offsets on the MXU.  FLOPs scale
+    with the number of active sites, not the volume
+    (tests/test_sparse_conv.py pins this with XLA cost_analysis).
+  * BatchNorm runs over the non-zero VALUES only (segment_sum per
+    channel — already compute proportional to nnz).
+  * Conv3D (pattern-dilating, strided) remains dense-backed: its output
+    pattern grows by the kernel volume, which kills the fixed-pattern
+    gather formulation; documented in docs/api_coverage.md.
 """
 from __future__ import annotations
 
@@ -104,12 +110,13 @@ class Conv3D(Layer):
         from ..autograd import engine
         dense = _coo(x).todense()
 
-        def conv_fn(xa, wa, ba=None):
+        def conv_fn(xa, wa, ba=None, groups=None):
             xt = jnp.moveaxis(xa, -1, 1)
             wt = jnp.transpose(wa, (4, 3, 0, 1, 2))
             o = ops.call_raw("conv3d", xt, wt, stride=self.stride,
                              padding=self.padding, dilation=self.dilation,
-                             groups=self.groups)
+                             groups=self.groups if groups is None
+                             else groups)
             if ba is not None:
                 o = o + ba.reshape([1, -1, 1, 1, 1])
             return jnp.moveaxis(o, 1, -1)
@@ -119,13 +126,16 @@ class Conv3D(Layer):
             ins.append(self.bias)
         out = engine.apply("sparse_conv3d", conv_fn, ins)
 
-        occ = (jnp.abs(dense).sum(axis=-1) > 0).astype(jnp.float32)
-        occ_out = conv_fn(occ[..., None],
-                          jnp.ones(self.weight._array.shape[:3] + (1, 1),
-                                   jnp.float32))
         if self._subm:
             mask = (jnp.abs(dense).sum(axis=-1, keepdims=True) > 0)
         else:
+            # occupancy dilation decides the output pattern; always a
+            # single-channel ungrouped conv regardless of self.groups
+            occ = (jnp.abs(dense).sum(axis=-1) > 0).astype(jnp.float32)
+            occ_out = conv_fn(
+                occ[..., None],
+                jnp.ones(self.weight._array.shape[:3] + (1, 1),
+                         jnp.float32), groups=1)
             mask = occ_out > 0
         mask = jnp.broadcast_to(mask, out.shape)
         # stay in tape-recorded Tensor ops: wrapping raw arrays here would
@@ -140,10 +150,93 @@ class Conv3D(Layer):
 
 class SubmConv3D(Conv3D):
     """Submanifold sparse conv: output non-zero pattern == input pattern
-    (reference: paddle.sparse.nn.SubmConv3D)."""
+    (reference: paddle.sparse.nn.SubmConv3D).
+
+    Real sparse compute: out[site] = sum_delta x[site+delta] @ W[delta]
+    over ACTIVE sites only.  Site lookup is sort-free at apply time —
+    coordinates linearize to sorted unique keys once, each kernel offset
+    resolves neighbors with searchsorted (O(S log S) int work), and the
+    K gathered [S, Cin] blocks contract with the [K, Cin, Cout] weight in
+    one einsum.  Compute scales with nnz, not the dense volume."""
 
     _subm = True
 
     def __init__(self, *args, **kwargs):
         kwargs.setdefault("padding", 1)
         super().__init__(*args, **kwargs)
+
+    def forward(self, x):
+        import jax
+        from ..autograd import engine
+        if self.groups != 1 or any(s != 1 for s in self.stride):
+            # grouped/strided submanifold falls back to the dense-masked
+            # path (pattern identical; compute dense)
+            return super().forward(x)
+        b = _coo(x)
+        N, Dd, H, W, Cin = b.shape
+        kd, kh, kw, _, Cout = self.weight._array.shape
+        pad = self.padding
+        pd, ph, pw = ((pad,) * 3 if isinstance(pad, int) else tuple(pad))
+        dil = self.dilation
+
+        idx = b.indices                       # [nnz, 5] (n, d, h, w, c)
+        coords, ch = idx[:, :4], idx[:, 4]
+        # linearized site key (batch-major); volumes must fit int32 —
+        # point-cloud grids do, and eager concreteness lets us assert
+        vol = N * Dd * H * W
+        if vol >= 2 ** 31:
+            return super().forward(x)
+        key = ((coords[:, 0] * Dd + coords[:, 1]) * H
+               + coords[:, 2]) * W + coords[:, 3]
+        ukeys = jnp.unique(key)               # [S] sorted (eager: concrete)
+        S = int(ukeys.shape[0])
+        rank = jnp.searchsorted(ukeys, key)
+        # delinearize unique sites back to coordinates
+        un = ukeys // (Dd * H * W)
+        rem = ukeys % (Dd * H * W)
+        ud = rem // (H * W)
+        uh = (rem % (H * W)) // W
+        uw = rem % W
+
+        # static per-offset neighbor resolution (ints only — outside grad)
+        gathers, hits = [], []
+        for od in range(kd):
+            for oh in range(kh):
+                for ow in range(kw):
+                    dd = od * dil[0] - pd
+                    dh = oh * dil[1] - ph
+                    dw = ow * dil[2] - pw
+                    qd, qh, qw = ud + dd, uh + dh, uw + dw
+                    valid = ((qd >= 0) & (qd < Dd) & (qh >= 0) & (qh < H)
+                             & (qw >= 0) & (qw < W))
+                    qkey = ((un * Dd + qd) * H + qh) * W + qw
+                    j = jnp.clip(jnp.searchsorted(ukeys, qkey), 0, S - 1)
+                    hit = valid & (ukeys[j] == qkey)
+                    gathers.append(j)
+                    hits.append(hit)
+        jall = jnp.stack(gathers)             # [K, S]
+        hall = jnp.stack(hits)                # [K, S]
+
+        def fn(vals, w, bias=None):
+            feat = jnp.zeros((S, Cin), vals.dtype).at[rank, ch].add(vals)
+            g = feat[jall] * hall[..., None].astype(vals.dtype)  # [K,S,Ci]
+            wk = w.reshape(kd * kh * kw, Cin, Cout)
+            out = jnp.einsum("ksi,kio->so", g, wk)
+            if bias is not None:
+                out = out + bias
+            return out.reshape(-1)            # [S * Cout]
+
+        ins = [x.values() if b.data.ndim == 1 else
+               Tensor._from_array(b.data), self.weight]
+        if self.bias is not None:
+            ins.append(self.bias)
+        vals_t = engine.apply("subm_conv3d", fn, ins)
+
+        site_coords = jnp.stack([un, ud, uh, uw], axis=1)  # [S, 4]
+        out_idx = jnp.concatenate(
+            [jnp.repeat(site_coords, Cout, axis=0),
+             jnp.tile(jnp.arange(Cout, dtype=site_coords.dtype),
+                      S)[:, None]], axis=1)   # [S*Cout, 5]
+        return SparseCooTensor(jsparse.BCOO(
+            (vals_t._array, out_idx), shape=(N, Dd, H, W, Cout)),
+            values_t=vals_t)
