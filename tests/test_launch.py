@@ -155,3 +155,47 @@ def test_two_process_dp_matches_single_process(tmp_path):
     ref = [float(step(x, y)) for _ in range(3)]
     mesh_mod._state.update(prev)
     np.testing.assert_allclose(mp_losses, ref, rtol=1e-5)
+
+
+def test_two_process_eager_send_recv(tmp_path):
+    """VERDICT r3 item 10: eager paddle.distributed.send/recv between two
+    launch processes (matched pair rides one process-mesh gather)."""
+    import textwrap
+    worker = tmp_path / "p2p_worker.py"
+    worker.write_text(textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {REPO!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import paddle_tpu as pt
+        import paddle_tpu.distributed as dist
+        dist.init_parallel_env()
+        rank = dist.get_rank()
+        if rank == 0:
+            x = pt.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+            dist.send(x, dst=1)
+        else:
+            y = pt.zeros([2, 3])
+            dist.recv(y, src=0)
+            np.testing.assert_allclose(
+                y.numpy(), np.arange(6, dtype=np.float32).reshape(2, 3))
+            with open(os.path.join({str(tmp_path)!r}, "ok.txt"), "w") as f:
+                f.write("ok")
+    """))
+    code = run(["--nproc_per_node", "2", "--master", "127.0.0.1:18993",
+                str(worker)])
+    assert code == 0
+    assert (tmp_path / "ok.txt").read_text() == "ok"
+
+
+def test_single_process_send_recv_loopback():
+    """world=1 self-send loops through the in-process queue."""
+    import numpy as np
+    import paddle_tpu as pt
+    import paddle_tpu.distributed as dist
+    x = pt.to_tensor(np.ones((3,), np.float32) * 7)
+    dist.send(x, dst=0)
+    y = pt.zeros([3])
+    dist.recv(y, src=0)
+    np.testing.assert_allclose(y.numpy(), 7.0)
