@@ -78,6 +78,31 @@ x = jnp.ones((256, 256), jnp.bfloat16)
 y = jax.jit(lambda a: a @ a)(x)
 y.block_until_ready()
 print(f"PROBE_OK devices={devs} init_s={time.time()-t0:.1f}", flush=True)
+# mosaic-compile smoke (VERDICT r3 item 8): one flash fwd+bwd at bench
+# shapes incl. GQA + additive mask, so kernel regressions surface here
+# instead of wedging a bench leg. Failure does NOT fail the probe - the
+# parent disables the pallas override and benches the XLA path.
+try:
+    sys.path.insert(0, os.environ["BENCH_REPO_DIR"])
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+    t1 = time.time()
+    B, Lq, H, Hkv, D = 1, 1024, 16, 4, 64
+    k1, k2, k3 = (jax.random.PRNGKey(i) for i in (1, 2, 3))
+    q = jax.random.normal(k1, (B, Lq, H, D), jnp.bfloat16)
+    kk = jax.random.normal(k2, (B, Lq, Hkv, D), jnp.bfloat16)
+    vv = jax.random.normal(k3, (B, Lq, Hkv, D), jnp.bfloat16)
+    mask = jnp.zeros((1, 1, Lq, Lq), jnp.float32)
+
+    def loss(q, kk, vv):
+        o = flash_attention(q, kk, vv, mask=mask, is_causal=True)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, kk, vv)
+    float(jnp.sum(g[0].astype(jnp.float32)))   # host-read sync
+    print(f"PROBE_KERNEL_OK gqa+mask fwd+bwd in {time.time()-t1:.1f}s",
+          flush=True)
+except Exception as e:
+    print(f"PROBE_KERNEL_FAIL {type(e).__name__}: {e}"[:400], flush=True)
 """
 
 
@@ -86,6 +111,7 @@ def probe_backend():
     env = dict(os.environ)
     env["PYTHONPATH"] = ""          # skip sitecustomize: we register with a
     env["JAX_PLATFORMS"] = "axon"   # short claim timeout instead
+    env["BENCH_REPO_DIR"] = os.path.dirname(os.path.abspath(__file__))
     env.setdefault("BENCH_CLAIM_TIMEOUT",
                    str(max(60, PROBE_TIMEOUT - 60)))
     for attempt in range(1, PROBE_RETRIES + 1):
@@ -106,6 +132,12 @@ def probe_backend():
              + (r.stdout.strip() if ok else
                 (r.stderr.strip().splitlines() or ['?'])[-1][:300]))
         if ok:
+            if "PROBE_KERNEL_FAIL" in r.stdout:
+                # mosaic kernel regression: bench the XLA path instead of
+                # wedging every leg (the failure line is logged above)
+                _log("# pallas kernel smoke FAILED - disabling the "
+                     "pallas override for this bench run")
+                os.environ["PADDLE_TPU_PALLAS"] = "0"
             return True
     return False
 

@@ -121,19 +121,18 @@ class DistributedTrainStep:
                        or pc.get("num_virtual_pipeline_stages") or 1)
         # pipeline schedule (reference: schedule_mode in fleet pipeline
         # configs): "1F1B" = hand-written two-scan custom_vjp holding only
-        # [M, mb] boundary activations per device (the default — it beats
-        # the 1F1B analytic memory budget, docs/pp_memory.md); "F-then-B"
-        # = differentiable GPipe scan.  vpp>1 always uses the interleaved
-        # differentiable scan.
+        # the per-microbatch boundary activations per device (the default
+        # — it beats the 1F1B analytic memory budget, docs/pp_memory.md;
+        # vpp>1 composes it with the interleaved wave, Megatron's
+        # production schedule); "F-then-B" = differentiable GPipe /
+        # interleaved scan.
         sched = (pc.get("schedule_mode") or hc.get("pp_schedule")
-                 or ("1F1B" if self.vpp == 1 else "F-then-B"))
+                 or "1F1B")
         self.pp_schedule = str(sched).upper().replace("-", "")
         if self.pp_schedule not in ("1F1B", "FTHENB", "GPIPE"):
             raise ValueError(
                 f"unknown pipeline schedule_mode {sched!r}: expected "
                 "'1F1B' or 'F-then-B'")
-        if self.vpp > 1:
-            self.pp_schedule = "FTHENB"   # interleaved scan handles vpp
         if self.vpp > 1 and self.n_microbatches < self.pp:
             raise ValueError(
                 f"virtual_pp_degree>1 needs accumulate_steps "
@@ -470,11 +469,12 @@ class DistributedTrainStep:
         moes = [l for b in blocks for l in b.sublayers(include_self=True)
                 if isinstance(l, MoELayer)]
 
-        # GPipe + 1F1B thread block buffers through the schedule scan, so
-        # train-mode BN running stats update per microbatch in order
-        # (round 4, VERDICT r3 item 7); the interleaved (vpp>1) scan keeps
+        # GPipe + 1F1B (incl. interleaved 1F1B) thread block buffers
+        # through the schedule scan, so train-mode BN running stats
+        # update per microbatch in order (round 4, VERDICT r3 item 7);
+        # only the differentiable interleaved (F-then-B vpp>1) scan keeps
         # them read-only
-        allow_mut = self.vpp == 1
+        allow_mut = self.pp_schedule == "1F1B" or self.vpp == 1
 
         def block_apply(leaf_dict, h, key):
             arrs = [leaf_dict[n] for n in leaf_names]
@@ -490,10 +490,13 @@ class DistributedTrainStep:
                         raise NotImplementedError(
                             f"pipelined block mutates buffer '{n}' "
                             f"(train-mode BatchNorm running stats?): "
-                            f"buffers are read-only inside the "
-                            f"interleaved (virtual_pp_degree>1) schedule "
-                            f"— set such layers to eval, keep them "
-                            f"outside the blocks, or use vpp=1")
+                            f"buffers are read-only in the "
+                            f"differentiable F-then-B interleaved "
+                            f"(virtual_pp_degree>1) schedule — use "
+                            f"schedule_mode='1F1B' (the default, which "
+                            f"threads buffer updates at any vpp), set "
+                            f"such layers to eval, or keep them outside "
+                            f"the blocks")
                     new_bufs["buf::" + n] = tbufs[n]._array
             aux = jnp.zeros((), jnp.float32)
             for l in template.sublayers(include_self=True):
@@ -524,7 +527,8 @@ class DistributedTrainStep:
             if self.pp_schedule == "1F1B":
                 res = pipeline_apply_1f1b(
                     block_apply, stacked_all, x_mb, rng, mesh,
-                    n_stages=self.pp, n_microbatches=M, mutable_bufs=mut)
+                    n_stages=self.pp, n_microbatches=M, mutable_bufs=mut,
+                    n_chunks=self.vpp)
             else:
                 res = pipeline_apply_hybrid(
                     block_apply, stacked_all, x_mb, rng, mesh,

@@ -126,6 +126,11 @@ def gpipe_hybrid(block_apply, n_stages, n_microbatches, axis_name="pp",
             state, out_buf, aux_acc, bstack = carry
             inject = x_mb[jnp.clip(t, 0, M - 1)]
             cur = jnp.where(idx == 0, inject, state)
+            # NOTE: no lax.cond bubble-skip here — differentiating
+            # through cond makes jax save per-step branch residuals that
+            # defeat the remat'd scan (measured 3x temp blowup); the
+            # bubble-compute skip lives in the 1F1B schedules, whose
+            # hand-written backward never differentiates the cond
             y, aux, bnew = _stage_scan(block_apply, my_params, cur,
                                        jax.random.fold_in(key, t), bstack)
             # stage idx holds microbatch t-idx at step t: only those
@@ -258,6 +263,8 @@ def interleaved_hybrid(block_apply, n_stages, n_microbatches, n_chunks,
             inject = x_mb[m]
             h0 = jnp.where(v == 0, inject, delayed)
             h = jnp.where(idx == 0, h0, state)
+            # no cond bubble-skip in the differentiable schedule — see
+            # the gpipe_hybrid note (grad-through-cond memory blowup)
             y, aux = stage_fn(chunk_params(v), h, v,
                               jax.random.fold_in(key, t))
             # device idx works (chunk v, microbatch m) when 0 <= t-idx < V*M
@@ -393,12 +400,14 @@ def onef1b_pipeline(block_apply, mesh, n_stages, n_microbatches,
             prev = lax.dynamic_index_in_dim(in_store, m, 0, keepdims=False)
             in_store = lax.dynamic_update_index_in_dim(
                 in_store, jnp.where(active, cur, prev), m, 0)
-            y, aux, bnew = _stage_scan(block_apply, my_params, cur,
-                                       jax.random.fold_in(key_d, m),
-                                       bstack)
-            aux_acc = aux_acc + jnp.where(active, aux, 0.0)
-            bstack = {n: jnp.where(active, bnew[n], bstack[n])
-                      for n in bstack}
+            # bubble steps skip the block compute (see gpipe_hybrid note)
+            y, aux, bstack = lax.cond(
+                active,
+                lambda: _stage_scan(block_apply, my_params, cur,
+                                    jax.random.fold_in(key_d, m), bstack),
+                lambda: (jnp.zeros_like(cur), jnp.zeros((), jnp.float32),
+                         bstack))
+            aux_acc = aux_acc + aux
             emit_t = jnp.clip(t - (P_ - 1), 0, M - 1)
             is_emit = (t >= P_ - 1) & (idx == P_ - 1)
             prev_o = lax.dynamic_index_in_dim(out_buf, emit_t, 0,
@@ -448,20 +457,24 @@ def onef1b_pipeline(block_apply, mesh, n_stages, n_microbatches,
                                         my_bufs)
                 return y, aux
 
-            (y, _aux), vjp_fn = jax.vjp(f, my_params, x_in)
-            dparams, dx = vjp_fn((g_in.astype(y.dtype),
-                                  jnp.where(active, daux,
-                                            0.0).astype(jnp.float32)))
-            # bubble lanes vjp garbage — masked out of every accumulator
+            def run_bwd():
+                (y, _aux), vjp_fn = jax.vjp(f, my_params, x_in)
+                return vjp_fn((g_in.astype(y.dtype),
+                               daux.astype(jnp.float32)))
+
+            def skip_bwd():   # bubble step: no recompute, no vjp FLOPs
+                return (jax.tree_util.tree_map(jnp.zeros_like, my_params),
+                        jnp.zeros_like(x_in))
+
+            dparams, dx = lax.cond(active, run_bwd, skip_bwd)
             gacc = jax.tree_util.tree_map(
-                lambda a, d: a + jnp.where(active, d, 0.0).astype(a.dtype),
-                gacc, dparams)
+                lambda a, d: a + d.astype(a.dtype), gacc, dparams)
             prev_dx = lax.dynamic_index_in_dim(dx_buf, m, 0, keepdims=False)
             dx_buf = lax.dynamic_update_index_in_dim(
                 dx_buf, jnp.where(active & (idx == 0),
                                   dx.astype(dx_buf.dtype), prev_dx), m, 0)
-            gstate = lax.ppermute(jnp.where(active, dx, 0.0), axis_name,
-                                  perm_rev)
+            # skip_bwd already zeros dx on bubble steps — permute as-is
+            gstate = lax.ppermute(dx, axis_name, perm_rev)
             return (gstate, gacc, dx_buf), None
 
         (gstate, gacc, dx_buf), _ = lax.scan(
@@ -474,11 +487,204 @@ def onef1b_pipeline(block_apply, mesh, n_stages, n_microbatches,
                     **{n: jnp.zeros_like(b) for n, b in my_bufs.items()}}
         return jax.tree_util.tree_map(lambda g: g[None], gacc), dx_mb
 
-    param_specs_of = lambda tree: jax.tree_util.tree_map(
-        lambda _: P(axis_name), tree)
+    return _two_scan_make(fwd_device, bwd_device, mesh, axis_name,
+                          mutable_bufs)
+
+
+def onef1b_interleaved(block_apply, mesh, n_stages, n_microbatches,
+                       n_chunks, axis_name="pp", mutable_bufs=False):
+    """Interleaved (virtual-pipeline) 1F1B: Megatron's production schedule
+    as a two-scan custom_vjp (reference: fleet pp_utils interleaved 1F1B).
+
+    Device p holds V=n_chunks non-contiguous chunks (chunk v = global
+    virtual stage v*P+p); the forward wave runs (chunk v, microbatch m)
+    at step v*M + m + p with the stage-(P-1)->0 inter-chunk wrap held
+    D = M - P steps in a ring FIFO (same schedule as interleaved_hybrid).
+    The hand-written backward wave mirrors it: bwd(v, m) on device p at
+    step (V-1-v)*M + m + (P-1-p), grads riding the REVERSE ring, with the
+    stage-0->(P-1) inter-chunk wrap held in a mirrored FIFO.  Memory: the
+    forward stores only the [V, M, mb] chunk-boundary inputs per device
+    (no x12 internals, no per-step scan carries) — the property that
+    made plain 1F1B hit its analytic budget now composes with the ~V
+    bubble shrink.  Requires M >= P.
+    """
+    P_, M, V = n_stages, n_microbatches, n_chunks
+    if M < P_:
+        raise ValueError(
+            f"interleaved 1F1B needs microbatches ({M}) >= stages ({P_})")
+    D = M - P_
+    T = V * M + P_ - 1
+    perm_fwd = [(i, (i + 1) % P_) for i in range(P_)]
+    perm_rev = [(i, (i - 1) % P_) for i in range(P_)]
+
+    def _chunk(tree, v, lpc):
+        return jax.tree_util.tree_map(
+            lambda a: lax.dynamic_slice_in_dim(a, v * lpc, lpc, 0), tree)
+
+    def _chunk_put(tree, rows, v, lpc):
+        return jax.tree_util.tree_map(
+            lambda t, r: lax.dynamic_update_slice_in_dim(
+                t, r.astype(t.dtype), v * lpc, 0), tree, rows)
+
+    def fwd_device(stacked_params, x_mb, key):
+        my_params, my_bufs = _device_tree(stacked_params, mutable_bufs)
+        n_rows = jax.tree_util.tree_leaves(my_params)[0].shape[0]
+        if n_rows % V:
+            raise ValueError(
+                f"per-device layer rows ({n_rows}) not divisible by "
+                f"n_chunks ({V})")
+        lpc = n_rows // V
+        idx = lax.axis_index(axis_name)
+        key_d = jax.random.fold_in(key, idx)
+        mb_shape = x_mb.shape[1:]
+
+        out_buf = jnp.zeros((M,) + mb_shape, x_mb.dtype)
+        in_store = jnp.zeros((V, M) + mb_shape, x_mb.dtype)
+        state = jnp.zeros(mb_shape, x_mb.dtype)
+        fifo = jnp.zeros((D + 1,) + mb_shape, x_mb.dtype)
+        aux_acc = jnp.zeros((), jnp.float32)
+
+        def body(carry, t):
+            state, out_buf, in_store, fifo, aux_acc, bstack = carry
+            rel = t - idx
+            v = jnp.clip(rel // M, 0, V - 1)
+            m = jnp.clip(rel % M, 0, M - 1)
+            active = (rel >= 0) & (rel < V * M)
+            if D > 0:
+                delayed = lax.dynamic_index_in_dim(
+                    fifo, (t + 1) % (D + 1), 0, keepdims=False)
+                fifo = lax.dynamic_update_index_in_dim(
+                    fifo, state, t % (D + 1), 0)
+            else:
+                delayed = state
+            inject = x_mb[m]
+            h0 = jnp.where(v == 0, inject, delayed)
+            h = jnp.where(idx == 0, h0, state)
+            # the saved residual: chunk v's stage input for microbatch m
+            prev = in_store[v, m]
+            in_store = in_store.at[v, m].set(jnp.where(active, h, prev))
+            cp = _chunk(my_params, v, lpc)
+            cb = _chunk(bstack, v, lpc) if bstack else {}
+            y, aux, newcb = lax.cond(
+                active,
+                lambda: _stage_scan(block_apply, cp, h,
+                                    jax.random.fold_in(key_d, v * M + m),
+                                    cb),
+                lambda: (jnp.zeros_like(h), jnp.zeros((), jnp.float32),
+                         cb))
+            aux_acc = aux_acc + aux
+            if bstack:
+                bstack = _chunk_put(bstack, newcb, v, lpc)
+            m_emit = jnp.clip(t - (V - 1) * M - (P_ - 1), 0, M - 1)
+            is_emit = (idx == P_ - 1) & (t >= (V - 1) * M + P_ - 1)
+            prev_o = lax.dynamic_index_in_dim(out_buf, m_emit, 0,
+                                              keepdims=False)
+            out_buf = lax.dynamic_update_index_in_dim(
+                out_buf, jnp.where(is_emit, y, prev_o), m_emit, 0)
+            state = lax.ppermute(y, axis_name, perm_fwd)
+            return (state, out_buf, in_store, fifo, aux_acc, bstack), None
+
+        (state, out_buf, in_store, fifo, aux_acc, bstack), _ = lax.scan(
+            body, (state, out_buf, in_store, fifo, aux_acc, my_bufs),
+            jnp.arange(T))
+        out = lax.psum(
+            jnp.where(idx == P_ - 1, out_buf, jnp.zeros_like(out_buf)),
+            axis_name)
+        aux_total = lax.psum(aux_acc, axis_name)
+        new_bufs = {n: b[None] for n, b in bstack.items()}
+        return out[None], aux_total, in_store[None], new_bufs
+
+    def bwd_device(stacked_params, in_store, key, dy, daux):
+        my_params, my_bufs = _device_tree(stacked_params, mutable_bufs)
+        n_rows = jax.tree_util.tree_leaves(my_params)[0].shape[0]
+        lpc = n_rows // V
+        in_store = in_store[0]
+        idx = lax.axis_index(axis_name)
+        key_d = jax.random.fold_in(key, idx)
+        mb_shape = dy.shape[1:]
+        skew = P_ - 1 - idx
+
+        gacc = jax.tree_util.tree_map(jnp.zeros_like, my_params)
+        dx_buf = jnp.zeros((M,) + mb_shape, dy.dtype)
+        gstate = jnp.zeros(mb_shape, dy.dtype)
+        gfifo = jnp.zeros((D + 1,) + mb_shape, dy.dtype)
+
+        def body(carry, s):
+            gstate, gacc, dx_buf, gfifo = carry
+            rel = s - skew
+            vb = V - 1 - jnp.clip(rel // M, 0, V - 1)
+            m = jnp.clip(rel % M, 0, M - 1)
+            active = (rel >= 0) & (rel < V * M)
+            # mirrored inter-chunk FIFO on the LAST stage: stage 0's
+            # bwd(v+1, m) grad arrives via the reverse ring and waits D
+            # steps before stage P-1 starts bwd(v, m)
+            if D > 0:
+                gdelayed = lax.dynamic_index_in_dim(
+                    gfifo, (s + 1) % (D + 1), 0, keepdims=False)
+                gfifo = lax.dynamic_update_index_in_dim(
+                    gfifo, gstate, s % (D + 1), 0)
+            else:
+                gdelayed = gstate
+            g_last = jnp.where(vb == V - 1, dy[m], gdelayed)
+            g_in = jnp.where(idx == P_ - 1, g_last, gstate)
+            x_in = in_store[vb, m]
+            cp = _chunk(my_params, vb, lpc)
+            cb = _chunk(my_bufs, vb, lpc) if my_bufs else {}
+
+            def f(params, x):
+                y, aux, _ = _stage_scan(
+                    block_apply, params, x,
+                    jax.random.fold_in(key_d, vb * M + m), cb)
+                return y, aux
+
+            def run_bwd():
+                (y, _aux), vjp_fn = jax.vjp(f, cp, x_in)
+                return vjp_fn((g_in.astype(y.dtype),
+                               daux.astype(jnp.float32)))
+
+            def skip_bwd():
+                return (jax.tree_util.tree_map(jnp.zeros_like, cp),
+                        jnp.zeros_like(x_in))
+
+            dcp, dx = lax.cond(active, run_bwd, skip_bwd)
+            grows = _chunk(gacc, vb, lpc)
+            gacc = _chunk_put(
+                gacc, jax.tree_util.tree_map(
+                    lambda a, d: a + d.astype(a.dtype), grows, dcp),
+                vb, lpc)
+            prev_dx = lax.dynamic_index_in_dim(dx_buf, m, 0,
+                                               keepdims=False)
+            is_dx = active & (idx == 0) & (vb == 0)
+            dx_buf = lax.dynamic_update_index_in_dim(
+                dx_buf, jnp.where(is_dx, dx.astype(dx_buf.dtype),
+                                  prev_dx), m, 0)
+            gstate = lax.ppermute(dx, axis_name, perm_rev)
+            return (gstate, gacc, dx_buf, gfifo), None
+
+        (gstate, gacc, dx_buf, gfifo), _ = lax.scan(
+            body, (gstate, gacc, dx_buf, gfifo), jnp.arange(T))
+        dx_mb = lax.psum(
+            jnp.where(idx == 0, dx_buf, jnp.zeros_like(dx_buf)), axis_name)
+        if my_bufs:
+            gacc = {**gacc,
+                    **{n: jnp.zeros_like(b) for n, b in my_bufs.items()}}
+        return jax.tree_util.tree_map(lambda g: g[None], gacc), dx_mb
+
+    return _two_scan_make(fwd_device, bwd_device, mesh, axis_name,
+                          mutable_bufs)
+
+
+
+
+def _two_scan_make(fwd_device, bwd_device, mesh, axis_name, mutable_bufs):
+    """Shared custom_vjp scaffolding for the two-scan 1F1B schedules.
+    fwd_device(stacked, x_mb, key) -> (out [1,M,mb], aux, in_store,
+    new_bufs); bwd_device(stacked, in_store, key, dy, daux) ->
+    (dstacked, dx_mb)."""
 
     def make(stacked_params):
-        pspecs = param_specs_of(stacked_params)
+        pspecs = jax.tree_util.tree_map(lambda _: P(axis_name),
+                                        stacked_params)
         buf_specs = {}
         if mutable_bufs and isinstance(stacked_params, dict):
             buf_specs = {n: P(axis_name) for n in stacked_params
@@ -518,14 +724,20 @@ def onef1b_pipeline(block_apply, mesh, n_stages, n_microbatches,
 
 def pipeline_apply_1f1b(block_apply, stacked_params, x_mb, key, mesh,
                         n_stages, n_microbatches, axis_name="pp",
-                        mutable_bufs=False):
-    """1F1B-memory schedule entry point; drop-in for pipeline_apply_hybrid
-    (n_chunks=1).  Must be called inside jit (partial-manual shard_map).
+                        mutable_bufs=False, n_chunks=1):
+    """1F1B-memory schedule entry point; drop-in for pipeline_apply_hybrid.
+    n_chunks > 1 uses the interleaved (virtual-pipeline) 1F1B wave.
+    Must be called inside jit (partial-manual shard_map).
     With mutable_bufs, returns (out, aux_total, new_stacked_bufs) where
     new_stacked_bufs are the schedule's committed 'buf::' leaf updates
     (BN running stats); otherwise (out, aux_total)."""
-    make = onef1b_pipeline(block_apply, mesh, n_stages, n_microbatches,
-                           axis_name, mutable_bufs=mutable_bufs)
+    if n_chunks > 1:
+        make = onef1b_interleaved(block_apply, mesh, n_stages,
+                                  n_microbatches, n_chunks, axis_name,
+                                  mutable_bufs=mutable_bufs)
+    else:
+        make = onef1b_pipeline(block_apply, mesh, n_stages, n_microbatches,
+                               axis_name, mutable_bufs=mutable_bufs)
     out, aux, new_bufs = make(stacked_params)(stacked_params, x_mb, key)
     if mutable_bufs:
         return out, aux, new_bufs

@@ -36,13 +36,15 @@ def _gpt(seed=0, layers=4, moe=False):
     return GPTForCausalLM(cfg)
 
 
-def _train(sched, pp, M, dp=1, moe=False, steps=3, seed=0, layers=4):
+def _train(sched, pp, M, dp=1, moe=False, steps=3, seed=0, layers=4,
+           vpp=1):
     """Build + train a few steps under `sched`; return (losses, state)."""
     from paddle_tpu.text import gpt_loss_fn
     strategy = fleet.DistributedStrategy()
     strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": 1,
                                "pp_degree": pp, "accumulate_steps": M,
-                               "pp_schedule": sched}
+                               "pp_schedule": sched,
+                               "virtual_pp_degree": vpp}
     fleet.init(is_collective=True, strategy=strategy)
     m = _gpt(seed=seed, layers=layers, moe=moe)
     opt = pt.optimizer.Adam(learning_rate=0.02, parameters=m.parameters())
@@ -56,11 +58,14 @@ def _train(sched, pp, M, dp=1, moe=False, steps=3, seed=0, layers=4):
     return losses, sd
 
 
-def _assert_parity(restore_mesh, pp, M, dp=1, moe=False, layers=4):
+def _assert_parity(restore_mesh, pp, M, dp=1, moe=False, layers=4,
+                   vpp=1):
     prev = dict(mesh_mod._state)
-    l_ref, sd_ref = _train("F-then-B", pp, M, dp=dp, moe=moe, layers=layers)
+    l_ref, sd_ref = _train("F-then-B", pp, M, dp=dp, moe=moe,
+                           layers=layers, vpp=1)
     mesh_mod._state.update(prev)
-    l_1f, sd_1f = _train("1F1B", pp, M, dp=dp, moe=moe, layers=layers)
+    l_1f, sd_1f = _train("1F1B", pp, M, dp=dp, moe=moe, layers=layers,
+                         vpp=vpp)
     assert np.allclose(l_ref, l_1f, rtol=3e-4, atol=3e-5), \
         f"loss mismatch: {l_ref} vs {l_1f}"
     worst = max(float(np.max(np.abs(sd_ref[k] - sd_1f[k])))
@@ -88,6 +93,17 @@ def test_1f1b_matches_gpipe_dp_x_pp(restore_mesh):
     _assert_parity(restore_mesh, pp=2, M=2, dp=2)
 
 
+def test_interleaved_1f1b_matches_gpipe(restore_mesh):
+    """vpp=2 x 1F1B (Megatron's interleaved 1F1B as a two-scan
+    custom_vjp): chunk waves + mirrored grad FIFO must reproduce the
+    plain differentiable schedule's math exactly."""
+    _assert_parity(restore_mesh, pp=2, M=4, layers=4, vpp=2)
+
+
+def test_interleaved_1f1b_matches_gpipe_pp2_vpp2_deep(restore_mesh):
+    _assert_parity(restore_mesh, pp=2, M=4, layers=8, vpp=2)
+
+
 def test_1f1b_is_default_schedule(restore_mesh):
     strategy = fleet.DistributedStrategy()
     strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
@@ -98,7 +114,7 @@ def test_1f1b_is_default_schedule(restore_mesh):
     opt = pt.optimizer.SGD(learning_rate=0.01, parameters=m.parameters())
     step = fleet.build_train_step(m, gpt_loss_fn, opt)
     assert step.pp_schedule == "1F1B"
-    # vpp>1 falls back to the interleaved differentiable scan
+    # vpp>1 also defaults to 1F1B (interleaved wave); F-then-B on request
     strategy2 = fleet.DistributedStrategy()
     strategy2.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
                                 "pp_degree": 2, "accumulate_steps": 4,
@@ -107,7 +123,16 @@ def test_1f1b_is_default_schedule(restore_mesh):
     m2 = _gpt()
     opt2 = pt.optimizer.SGD(learning_rate=0.01, parameters=m2.parameters())
     step2 = fleet.build_train_step(m2, gpt_loss_fn, opt2)
-    assert step2.pp_schedule == "FTHENB"
+    assert step2.pp_schedule == "1F1B"
+    strategy3 = fleet.DistributedStrategy()
+    strategy3.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                "pp_degree": 2, "accumulate_steps": 4,
+                                "pp_schedule": "F-then-B"}
+    fleet.init(is_collective=True, strategy=strategy3)
+    m3 = _gpt()
+    opt3 = pt.optimizer.SGD(learning_rate=0.01, parameters=m3.parameters())
+    step3 = fleet.build_train_step(m3, gpt_loss_fn, opt3)
+    assert step3.pp_schedule == "FTHENB"
 
 
 def test_1f1b_full_step_memory_below_gpipe(restore_mesh):

@@ -58,7 +58,7 @@ def main():
     rows = []
     for remat, vpp, sched in ((False, 1, "F-then-B"), (True, 1, "F-then-B"),
                               (True, 2, "F-then-B"), (False, 1, "1F1B"),
-                              (True, 1, "1F1B")):
+                              (True, 1, "1F1B"), (True, 2, "1F1B")):
         if vpp > 1 and (M < P or lps % vpp):
             continue
         strategy = fleet.DistributedStrategy()
