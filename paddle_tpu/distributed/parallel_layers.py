@@ -96,6 +96,23 @@ class ParallelCrossEntropy(Layer):
                                ignore_index=self.ignore_index)
 
 
+def seq_shard(x, enabled, cache=None):
+    """Megatron-SP memory half of sequence_parallel (reference: fleet's
+    sequence_parallel inside mp groups): constrain a [B, S, H] residual
+    stream to be SEQ-sharded over "mp", so layernorm/dropout/residual
+    adds hold 1/mp of the activations and GSPMD inserts the Megatron
+    g/g-bar all-gather / reduce-scatter pairs around the mp matmuls.
+    Decode caches skip it (Lq=1 activations, constraint churn not worth
+    it).  Under pp the blocks run inside the partial-manual shard_map
+    where a full-mesh constraint cannot be placed — shard_activation
+    already degrades to identity there."""
+    if not enabled or cache is not None:
+        return x
+    if mesh_mod.degree("mp") <= 1:
+        return x
+    return shard_activation(x, (None, "mp", None))
+
+
 def shard_activation(x, spec):
     """with_sharding_constraint on a Tensor (sequence-parallelism hook),
     recorded as a differentiable op. No-op when no mesh is active."""

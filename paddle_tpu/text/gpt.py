@@ -38,6 +38,7 @@ class GPTConfig:
                  max_position_embeddings=2048, hidden_dropout=0.1,
                  attention_dropout=0.1, initializer_range=0.02,
                  use_recompute=False, sequence_parallel=False,
+                 context_parallel=False,
                  tensor_parallel=None, num_experts=0, moe_top_k=2,
                  moe_capacity_factor=1.25, moe_every=1,
                  moe_aux_weight=0.01):
@@ -51,7 +52,14 @@ class GPTConfig:
         self.attention_dropout = attention_dropout
         self.initializer_range = initializer_range
         self.use_recompute = use_recompute
+        # sequence_parallel = Megatron-SP: residual stream SEQ-sharded
+        # over "mp" between the tp matmuls (reference: fleet's
+        # sequence_parallel inside mp groups).  context_parallel = ring
+        # attention over the "mp" axis for long sequences (reference:
+        # sep_degree / incubate RingFlashAttention).  Orthogonal flags;
+        # both may be on.
         self.sequence_parallel = sequence_parallel
+        self.context_parallel = context_parallel
         # MoE (GShard/Switch style): num_experts > 0 replaces the FFN of
         # every `moe_every`-th block with a routed MoELayer (reference
         # analog: GPT-MoE configs in the incubate moe stack)
@@ -91,7 +99,16 @@ class GPTAttention(nn.Layer):
         self.out_proj = _linear(cfg, cfg.hidden_size, cfg.hidden_size,
                                 column=False)
         self.dropout_p = cfg.attention_dropout
-        self.sequence_parallel = cfg.sequence_parallel
+        self.context_parallel = cfg.context_parallel
+        if self.context_parallel and cfg.attention_dropout > 0:
+            # the kv-ring kernel has no dropout support (same as the
+            # reference's RingFlashAttention); silently training with
+            # different regularization than the config says would be a
+            # trap — fail loudly instead
+            raise ValueError(
+                "context_parallel ring attention does not support "
+                "attention_dropout > 0; set attention_dropout=0.0 "
+                "(hidden_dropout is unaffected)")
 
     def forward(self, x, cache=None):
         from .. import tensor_api as T
@@ -114,7 +131,7 @@ class GPTAttention(nn.Layer):
             out = F.scaled_dot_product_attention(
                 q, k, v, is_causal=(s > 1), training=self.training,
                 dropout_p=0.0)
-        elif self.sequence_parallel and mesh_mod.degree("mp") > 1:
+        elif self.context_parallel and mesh_mod.degree("mp") > 1:
             from ..distributed.ring_attention import ring_attention
             from ..autograd import engine
             out = engine.apply(
@@ -158,9 +175,13 @@ class GPTBlock(nn.Layer):
         else:
             self.mlp = GPTMLP(cfg)
         self.dropout = nn.Dropout(cfg.hidden_dropout)
+        self.sequence_parallel = cfg.sequence_parallel
 
     def forward(self, x, cache=None, return_aux=False):
+        from ..distributed.parallel_layers import seq_shard
+        x = seq_shard(x, self.sequence_parallel, cache)
         x = x + self.dropout(self.attn(self.ln_1(x), cache=cache))
+        x = seq_shard(x, self.sequence_parallel, cache)
         x = x + self.dropout(self.mlp(self.ln_2(x)))
         if return_aux:
             # explicit output so the router aux loss crosses recompute's

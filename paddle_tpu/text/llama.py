@@ -33,7 +33,7 @@ class LlamaConfig:
                  max_position_embeddings=4096, rms_norm_eps=1e-6,
                  rope_theta=10000.0, initializer_range=0.02,
                  use_recompute=False, sequence_parallel=False,
-                 tensor_parallel=None):
+                 context_parallel=False, tensor_parallel=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -45,7 +45,12 @@ class LlamaConfig:
         self.rope_theta = rope_theta
         self.initializer_range = initializer_range
         self.use_recompute = use_recompute
+        # sequence_parallel = Megatron-SP residual seq-sharding;
+        # context_parallel = ring attention over "mp" (GQA-native ring —
+        # unrepeated kv shards rotate).  See GPTConfig for the mapping to
+        # the reference's fleet sequence_parallel / RingFlashAttention.
         self.sequence_parallel = sequence_parallel
+        self.context_parallel = context_parallel
         self.tensor_parallel = tensor_parallel if tensor_parallel is not None \
             else mesh_mod.degree("mp") > 1
 
@@ -136,6 +141,13 @@ class LlamaAttention(nn.Layer):
             out = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=mask, dropout_p=0.0,
                 training=self.training)
+        elif (cache is None and cfg.context_parallel
+              and mesh_mod.degree("mp") > 1):
+            from ..distributed.ring_attention import ring_attention
+            out = engine.apply(
+                "ring_attention",
+                lambda q_, k_, v_: ring_attention(q_, k_, v_, causal=True),
+                [q, k, v])
         else:
             out = F.scaled_dot_product_attention(
                 q, k, v, is_causal=(cache is None or s > 1), dropout_p=0.0,
@@ -164,9 +176,13 @@ class LlamaBlock(nn.Layer):
         self.post_attention_layernorm = nn.RMSNorm(cfg.hidden_size,
                                                    cfg.rms_norm_eps)
         self.mlp = LlamaMLP(cfg)
+        self.sequence_parallel = cfg.sequence_parallel
 
     def forward(self, x, cache=None):
+        from ..distributed.parallel_layers import seq_shard
+        x = seq_shard(x, self.sequence_parallel, cache)
         x = x + self.self_attn(self.input_layernorm(x), cache=cache)
+        x = seq_shard(x, self.sequence_parallel, cache)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
 
