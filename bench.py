@@ -1,7 +1,10 @@
 """Headline benchmarks (BASELINE.json): GPT tokens/sec/chip (headline,
-printed as ONE json line on stdout), plus ResNet-50 images/sec/chip and a
-small LLaMA hybrid-parallel leg (json lines on stderr so the driver tail
-records them without disturbing the one-line stdout contract).
+printed as ONE json line on stdout), plus stderr legs covering every
+BASELINE config: ResNet-50 img/s (config 1), BERT-base fine-tune
+samples/s (config 2), LLaMA hybrid-parallel tok/s (config 4), ERNIE-3.0
+inference samples/s through the deployment API (config 5), GPT-MoE and
+GPT-2.7B ladder legs (json lines on stderr so the driver tail records
+them without disturbing the one-line stdout contract).
 
 Robustness (round-1 postmortem: the axon backend takes ~25min to FAIL init,
 which burned the whole driver budget twice):
@@ -315,8 +318,89 @@ def run_moe(steps=10, warmup=2, preset="gpt3-350M", experts=8, top_k=2,
                    num_experts=experts, moe_top_k=top_k)
 
 
+def run_bert(steps=20, warmup=3, batch=32, seq=128):
+    """BASELINE config 2: BERT-base fine-tune (single-chip leg of the dp
+    job — the dp collectives are GSPMD-inserted and identical in shape at
+    dp>1)."""
+    import paddle_tpu as pt
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.text.bert import BertConfig, BertForSequenceClassification
+
+    pt.seed(0)
+    cfg = BertConfig(hidden_dropout_prob=0.1)   # bert-base defaults
+    model = BertForSequenceClassification(cfg, num_classes=2)
+    opt = pt.optimizer.AdamW(learning_rate=2e-5,
+                             parameters=model.parameters())
+    model, opt = pt.amp.decorate(models=model, optimizers=opt,
+                                 dtype="bfloat16", master_weight=False)
+
+    def loss_fn(m, ids, seg, y):
+        return F.cross_entropy(m(ids, seg), y, reduction="mean")
+
+    step = pt.jit.train_step(model, loss_fn, opt)
+    ids = pt.randint(0, cfg.vocab_size, [batch, seq])
+    seg = pt.zeros([batch, seq], dtype="int64")
+    y = pt.randint(0, 2, [batch])
+    for _ in range(warmup):
+        loss = step(ids, seg, y)
+    float(loss._array)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, seg, y)
+    final = float(loss._array)
+    dt = time.perf_counter() - t0
+    n_params = sum(p.size for p in model.parameters())
+    return {"sps": batch * steps / dt, "n_params": int(n_params),
+            "seq": seq, "loss": final, "devices": _dev_str()}
+
+
+def run_ernie_infer(steps=30, warmup=5, batch=32, seq=128,
+                    preset="ernie-3.0-medium-zh"):
+    """BASELINE config 5: ERNIE-3.0 inference through the deployment API
+    (to_static -> StableHLO artifact -> inference.create_predictor — the
+    CINN-fused-graph analog is the XLA-compiled artifact)."""
+    import os as _os
+    import tempfile
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.text.ernie import (ernie_config_from_preset,
+                                       ErnieForSequenceClassification)
+    from paddle_tpu.jit.save_load import InputSpec, save_inference
+    from paddle_tpu import inference
+
+    pt.seed(0)
+    cfg = ernie_config_from_preset(preset, hidden_dropout_prob=0.0)
+    model = ErnieForSequenceClassification(cfg, num_classes=2)
+    model.eval()
+    with tempfile.TemporaryDirectory() as d:
+        path = _os.path.join(d, "ernie_deploy")
+        # static batch: XLA-idiomatic (and ERNIE's position-id arange
+        # trips jax shape-poly comparisons under a symbolic batch)
+        save_inference(model, path,
+                       [InputSpec([batch, seq], "int64", "input_ids")])
+        predictor = inference.create_predictor(inference.Config(path))
+    h = predictor.get_input_handle(predictor.get_input_names()[0])
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int64")
+    h.copy_from_cpu(ids)
+    for _ in range(warmup):
+        predictor.run()
+    out = predictor.get_output_handle(predictor.get_output_names()[0])
+    np.asarray(out.copy_to_cpu()).sum()   # host read = sync
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        predictor.run()
+    logits = np.asarray(out.copy_to_cpu())
+    dt = time.perf_counter() - t0
+    n_params = sum(p.size for p in model.parameters())
+    return {"sps": batch * steps / dt, "n_params": int(n_params),
+            "seq": seq, "logit0": float(logits.reshape(-1)[0]),
+            "devices": _dev_str()}
+
+
 CHILD_FNS = {"gpt": run_gpt, "resnet": run_resnet, "llama": run_llama,
-             "moe": run_moe}
+             "moe": run_moe, "bert": run_bert,
+             "ernie_infer": run_ernie_infer}
 
 
 def _child_main(spec):
@@ -545,6 +629,32 @@ def main():
                 "vs_baseline": round(res["tps"] / base, 3),
                 "total_params": res["n_params"],
                 "active_params": act}))
+    if _left() > 400:
+        # BASELINE config 2: BERT-base fine-tune. Baseline derived like
+        # the LM legs: A100 peak x assumed MFU over 6N FLOPs/token
+        res = _spawn({"kind": "bert"}, min(PRESET_TIMEOUT, _left()))
+        if res:
+            record["legs"]["bert"] = res
+            base_sps = (A100_PEAK_TFLOPS * 1e12 * A100_ASSUMED_MFU
+                        / (6.0 * res["n_params"] * res["seq"]))
+            _log(json.dumps({
+                "metric": "BERT-base fine-tune samples/sec/chip (seq128)",
+                "value": round(res["sps"], 1), "unit": "samples/s/chip",
+                "vs_baseline": round(res["sps"] / base_sps, 3)}))
+    if _left() > 400:
+        # BASELINE config 5: ERNIE-3.0 inference via the deployment API
+        # (jit.save StableHLO artifact -> create_predictor). Inference
+        # does 2N FLOPs/token; same derived-A100 methodology.
+        res = _spawn({"kind": "ernie_infer"}, min(PRESET_TIMEOUT, _left()))
+        if res:
+            record["legs"]["ernie_infer"] = res
+            base_sps = (A100_PEAK_TFLOPS * 1e12 * A100_ASSUMED_MFU
+                        / (2.0 * res["n_params"] * res["seq"]))
+            _log(json.dumps({
+                "metric": "ERNIE-3.0-medium infer samples/sec/chip "
+                          "(deployment API, seq128)",
+                "value": round(res["sps"], 1), "unit": "samples/s/chip",
+                "vs_baseline": round(res["sps"] / base_sps, 3)}))
     if _left() > 500 and os.environ.get("BENCH_SKIP_27B") != "1":
         # model-ladder leg above the headline (VERDICT r2 item 8):
         # GPT-2.7B, Adafactor + recompute + pure bf16 (~5.4GB params)
