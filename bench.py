@@ -635,8 +635,8 @@ def main():
         res = _spawn({"kind": "bert"}, min(PRESET_TIMEOUT, _left()))
         if res:
             record["legs"]["bert"] = res
-            base_sps = (A100_PEAK_TFLOPS * 1e12 * A100_ASSUMED_MFU
-                        / (6.0 * res["n_params"] * res["seq"]))
+            # same derived bar as the LM legs, per SAMPLE of seq tokens
+            base_sps = _gpt_baseline_tps(res["n_params"]) / res["seq"]
             _log(json.dumps({
                 "metric": "BERT-base fine-tune samples/sec/chip (seq128)",
                 "value": round(res["sps"], 1), "unit": "samples/s/chip",

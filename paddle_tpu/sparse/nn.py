@@ -2,18 +2,19 @@
 BN / activation layers for point-cloud workloads).
 
 TPU backing (round 4):
-  * SubmConv3D is REAL sparse compute — gather -> matmul -> scatter over
-    the BCOO indices with compute proportional to nnz: unique active
-    sites found by sort/searchsorted on linearized coordinates, neighbor
-    rows gathered per kernel offset, and ONE stacked einsum
-    ("ksi,kio->so") contracts all K offsets on the MXU.  FLOPs scale
-    with the number of active sites, not the volume
-    (tests/test_sparse_conv.py pins this with XLA cost_analysis).
+  * SubmConv3D AND strided Conv3D are REAL sparse compute — gather ->
+    matmul -> scatter over the BCOO indices with compute proportional to
+    nnz: unique active sites by sort/searchsorted on linearized
+    coordinates (_prep_sparse_conv; strided output sites are the
+    stride-grid union of active receptive fields), neighbor rows
+    gathered per kernel offset, and ONE stacked einsum ("ksi,kio->so")
+    contracts all K offsets on the MXU.  FLOPs scale with the number of
+    active sites, not the volume (tests/test_sparse_conv.py pins this
+    with XLA cost_analysis).
   * BatchNorm runs over the non-zero VALUES only (segment_sum per
     channel — already compute proportional to nnz).
-  * Conv3D (pattern-dilating, strided) remains dense-backed: its output
-    pattern grows by the kernel volume, which kills the fixed-pattern
-    gather formulation; documented in docs/api_coverage.md.
+  * groups>1 and int32-key-overflow volumes fall back to the
+    dense-masked formulation (same semantics, dense compute).
 """
 from __future__ import annotations
 
@@ -79,9 +80,95 @@ class BatchNorm(Layer):
                                             shape=b.shape))
 
 
+def _lin(n, d, h, w, Dd, H, W):
+    return ((n * Dd + d) * H + h) * W + w
+
+
+def _delin(keys, Dd, H, W):
+    n = keys // (Dd * H * W)
+    rem = keys % (Dd * H * W)
+    return n, rem // (H * W), (rem % (H * W)) // W, rem % W
+
+
+def _prep_sparse_conv(b, kdims, stride, pad, dil, subm):
+    """Eager site/neighbor resolution shared by SubmConv3D and strided
+    Conv3D: unique active INPUT sites by sorted linearized keys; OUTPUT
+    sites = input sites (subm) or the stride-grid union of every
+    offset's receptive-field image (strided); per-offset neighbor rows
+    via searchsorted.  Index work is O((S_in + S_out) * K log S) ints —
+    no dense volume is ever touched.  Returns None when the volume
+    overflows int32 keys (caller falls back to the dense path)."""
+    import jax
+    N, Dd, H, W, _C = b.shape
+    kd, kh, kw = kdims
+    sd, sh, sw = stride
+    pd, ph, pw = pad
+    if N * Dd * H * W >= 2 ** 31:
+        return None
+    idx = b.indices
+    coords, ch = idx[:, :4], idx[:, 4]
+    key_in = _lin(coords[:, 0], coords[:, 1], coords[:, 2], coords[:, 3],
+                  Dd, H, W)
+    ukeys = jnp.unique(key_in)
+    S = int(ukeys.shape[0])
+    rank = jnp.searchsorted(ukeys, key_in)
+    un, ud, uh, uw = _delin(ukeys, Dd, H, W)
+
+    offsets = [(od, oh, ow) for od in range(kd) for oh in range(kh)
+               for ow in range(kw)]
+    if subm:
+        Do, Ho, Wo = Dd, H, W
+        on, od_, oh_, ow_ = un, ud, uh, uw
+    else:
+        Do = (Dd + 2 * pd - dil[0] * (kd - 1) - 1) // sd + 1
+        Ho = (H + 2 * ph - dil[1] * (kh - 1) - 1) // sh + 1
+        Wo = (W + 2 * pw - dil[2] * (kw - 1) - 1) // sw + 1
+        if N * Do * Ho * Wo >= 2 ** 31:
+            return None
+        big = N * Do * Ho * Wo          # sentinel for invalid candidates
+        cands = []
+        for od, oh, ow in offsets:
+            # input site u feeds output o iff o*s - p + off*dil == u
+            nd, nh, nw = (ud + pd - od * dil[0], uh + ph - oh * dil[1],
+                          uw + pw - ow * dil[2])
+            ok = ((nd % sd == 0) & (nh % sh == 0) & (nw % sw == 0))
+            qd, qh, qw = nd // sd, nh // sh, nw // sw
+            ok &= ((qd >= 0) & (qd < Do) & (qh >= 0) & (qh < Ho)
+                   & (qw >= 0) & (qw < Wo))
+            cands.append(jnp.where(ok, _lin(un, qd, qh, qw, Do, Ho, Wo),
+                                   big))
+        allk = jnp.unique(jnp.concatenate(cands))
+        okeys = allk[allk < big]        # eager: concrete boolean mask
+        on, od_, oh_, ow_ = _delin(okeys, Do, Ho, Wo)
+
+    gathers, hits = [], []
+    for od, oh, ow in offsets:
+        # unified: input coord of output site o at this offset is
+        # o*s - p + off*dil (subm passes stride 1, so o == u)
+        qd = od_ * sd - pd + od * dil[0]
+        qh = oh_ * sh - ph + oh * dil[1]
+        qw = ow_ * sw - pw + ow * dil[2]
+        valid = ((qd >= 0) & (qd < Dd) & (qh >= 0) & (qh < H)
+                 & (qw >= 0) & (qw < W))
+        qkey = _lin(on, qd, qh, qw, Dd, H, W)
+        j = jnp.clip(jnp.searchsorted(ukeys, qkey), 0, max(S - 1, 0))
+        hits.append(valid & (ukeys[j] == qkey))
+        gathers.append(j)
+    return dict(rank=rank, ch=ch, S=S,
+                jall=jnp.stack(gathers), hall=jnp.stack(hits),
+                out_sites=jnp.stack([on, od_, oh_, ow_], axis=1),
+                out_dims=(Do, Ho, Wo))
+
+
 class Conv3D(Layer):
     """Sparse 3-D conv on (N, D, H, W, C) COO input; output pattern is the
-    conv-dilated occupancy (reference: paddle.sparse.nn.Conv3D)."""
+    conv-dilated occupancy (reference: paddle.sparse.nn.Conv3D).
+
+    Real sparse compute since round 4 (groups=1): output sites are the
+    stride-grid union of the active receptive fields, features gather per
+    kernel offset and contract in ONE [K,So,Cin] x [K,Cin,Cout] einsum —
+    FLOPs scale with active sites, not volume.  groups>1 (and int32 key
+    overflow) fall back to the dense-masked formulation."""
 
     _subm = False
 
@@ -106,6 +193,55 @@ class Conv3D(Layer):
         self.groups = groups
 
     def forward(self, x):
+        if self.groups == 1:
+            prep = _prep_sparse_conv(
+                _coo(x), self.weight._array.shape[:3], self.stride,
+                (self.padding,) * 3 if isinstance(self.padding, int)
+                else tuple(self.padding), self.dilation, self._subm)
+            if prep is not None:
+                return self._sparse_forward(x, prep)
+        return self._dense_forward(x)
+
+    def _sparse_forward(self, x, prep):
+        """gather -> stacked einsum -> scatter over active sites."""
+        from ..autograd import engine
+        b = _coo(x)
+        N = b.shape[0]
+        Cin = b.shape[-1]
+        Cout = self.weight._array.shape[-1]
+        kd, kh, kw = self.weight._array.shape[:3]
+        S, rank, ch = prep["S"], prep["rank"], prep["ch"]
+        jall, hall = prep["jall"], prep["hall"]
+
+        def fn(vals, w, bias=None):
+            feat = jnp.zeros((S, Cin), vals.dtype).at[rank, ch].add(vals)
+            g = feat[jall] * hall[..., None].astype(vals.dtype)
+            out = jnp.einsum("ksi,kio->so", g,
+                             w.reshape(kd * kh * kw, Cin, Cout))
+            if bias is not None:
+                out = out + bias
+            return out.reshape(-1)        # [So * Cout]
+
+        ins = [x.values() if b.data.ndim == 1
+               else Tensor._from_array(b.data), self.weight]
+        if self.bias is not None:
+            ins.append(self.bias)
+        vals_t = engine.apply("subm_conv3d" if self._subm
+                              else "sparse_conv3d", fn, ins)
+
+        sites = prep["out_sites"]
+        So = sites.shape[0]
+        out_idx = jnp.concatenate(
+            [jnp.repeat(sites, Cout, axis=0),
+             jnp.tile(jnp.arange(Cout, dtype=sites.dtype),
+                      So)[:, None]], axis=1)
+        Do, Ho, Wo = prep["out_dims"]
+        return SparseCooTensor(jsparse.BCOO(
+            (vals_t._array, out_idx), shape=(N, Do, Ho, Wo, Cout)),
+            values_t=vals_t)
+
+    def _dense_forward(self, x):
+        """Dense-masked fallback (groups>1, int32 key overflow)."""
         from ..ops import dispatch as ops
         from ..autograd import engine
         dense = _coo(x).todense()
@@ -164,79 +300,11 @@ class SubmConv3D(Conv3D):
     def __init__(self, *args, **kwargs):
         kwargs.setdefault("padding", 1)
         super().__init__(*args, **kwargs)
-
-    def forward(self, x):
-        import jax
-        from ..autograd import engine
-        if self.groups != 1 or any(s != 1 for s in self.stride):
-            # grouped/strided submanifold falls back to the dense-masked
-            # path (pattern identical; compute dense)
-            return super().forward(x)
-        b = _coo(x)
-        N, Dd, H, W, Cin = b.shape
-        kd, kh, kw, _, Cout = self.weight._array.shape
-        pad = self.padding
-        pd, ph, pw = ((pad,) * 3 if isinstance(pad, int) else tuple(pad))
-        dil = self.dilation
-
-        idx = b.indices                       # [nnz, 5] (n, d, h, w, c)
-        coords, ch = idx[:, :4], idx[:, 4]
-        # linearized site key (batch-major); volumes must fit int32 —
-        # point-cloud grids do, and eager concreteness lets us assert
-        vol = N * Dd * H * W
-        if vol >= 2 ** 31:
-            return super().forward(x)
-        key = ((coords[:, 0] * Dd + coords[:, 1]) * H
-               + coords[:, 2]) * W + coords[:, 3]
-        ukeys = jnp.unique(key)               # [S] sorted (eager: concrete)
-        S = int(ukeys.shape[0])
-        rank = jnp.searchsorted(ukeys, key)
-        # delinearize unique sites back to coordinates
-        un = ukeys // (Dd * H * W)
-        rem = ukeys % (Dd * H * W)
-        ud = rem // (H * W)
-        uh = (rem % (H * W)) // W
-        uw = rem % W
-
-        # static per-offset neighbor resolution (ints only — outside grad)
-        gathers, hits = [], []
-        for od in range(kd):
-            for oh in range(kh):
-                for ow in range(kw):
-                    dd = od * dil[0] - pd
-                    dh = oh * dil[1] - ph
-                    dw = ow * dil[2] - pw
-                    qd, qh, qw = ud + dd, uh + dh, uw + dw
-                    valid = ((qd >= 0) & (qd < Dd) & (qh >= 0) & (qh < H)
-                             & (qw >= 0) & (qw < W))
-                    qkey = ((un * Dd + qd) * H + qh) * W + qw
-                    j = jnp.clip(jnp.searchsorted(ukeys, qkey), 0, S - 1)
-                    hit = valid & (ukeys[j] == qkey)
-                    gathers.append(j)
-                    hits.append(hit)
-        jall = jnp.stack(gathers)             # [K, S]
-        hall = jnp.stack(hits)                # [K, S]
-
-        def fn(vals, w, bias=None):
-            feat = jnp.zeros((S, Cin), vals.dtype).at[rank, ch].add(vals)
-            g = feat[jall] * hall[..., None].astype(vals.dtype)  # [K,S,Ci]
-            wk = w.reshape(kd * kh * kw, Cin, Cout)
-            out = jnp.einsum("ksi,kio->so", g, wk)
-            if bias is not None:
-                out = out + bias
-            return out.reshape(-1)            # [S * Cout]
-
-        ins = [x.values() if b.data.ndim == 1 else
-               Tensor._from_array(b.data), self.weight]
-        if self.bias is not None:
-            ins.append(self.bias)
-        vals_t = engine.apply("subm_conv3d", fn, ins)
-
-        site_coords = jnp.stack([un, ud, uh, uw], axis=1)  # [S, 4]
-        out_idx = jnp.concatenate(
-            [jnp.repeat(site_coords, Cout, axis=0),
-             jnp.tile(jnp.arange(Cout, dtype=site_coords.dtype),
-                      S)[:, None]], axis=1)   # [S*Cout, 5]
-        return SparseCooTensor(jsparse.BCOO(
-            (vals_t._array, out_idx), shape=(N, Dd, H, W, Cout)),
-            values_t=vals_t)
+        if any(s != 1 for s in self.stride):
+            # "output pattern == input pattern" is only defined at
+            # stride 1 (the reference's submanifold convs likewise);
+            # the dense-masked fallback can't represent it either
+            raise ValueError(
+                "SubmConv3D requires stride=1 (submanifold output "
+                "pattern == input pattern); use Conv3D for strided "
+                "sparse convolution")

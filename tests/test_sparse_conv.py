@@ -128,6 +128,45 @@ def test_subm_conv_flops_scale_with_nnz_not_volume():
     assert flops[16] < dense_flops / 10, (flops, dense_flops)
 
 
+@pytest.mark.parametrize("stride,padding", [(2, 1), (1, 0), (2, 0)])
+def test_strided_conv3d_matches_dense_masked(stride, padding):
+    """Strided Conv3D is real sparse compute too (round 4): output sites
+    = stride-grid union of active receptive fields; values and pattern
+    must equal the dense conv + occupancy-dilation mask."""
+    import paddle_tpu.tensor_api as T
+    import paddle_tpu.nn.functional as F
+    pt.seed(7)
+    x = _random_sparse(vol=(2, 9, 9, 9), C=3, nsites=30, seed=11)
+    layer = Conv3D(3, 5, kernel_size=3, stride=stride, padding=padding)
+    out = layer(x)
+
+    dense = x.to_dense()
+    xt = T.transpose(dense, [0, 4, 1, 2, 3])
+    o = F.conv3d(xt, T.transpose(layer.weight, [4, 3, 0, 1, 2]),
+                 bias=layer.bias, stride=stride, padding=padding)
+    o = T.transpose(o, [0, 2, 3, 4, 1])
+    occ = (np.abs(np.asarray(dense._array)).sum(-1) > 0).astype(np.float32)
+    occ_o = F.conv3d(pt.to_tensor(occ[:, None]),
+                     pt.ones([1, 1, 3, 3, 3]), stride=stride,
+                     padding=padding)
+    mask = (np.asarray(occ_o._array) > 0).transpose(0, 2, 3, 4, 1)
+    ref = np.asarray(o._array) * mask
+    np.testing.assert_allclose(np.asarray(out.to_dense()._array), ref,
+                               rtol=1e-4, atol=1e-5)
+    # pattern exactness: one COO entry per (active out site, out channel)
+    assert out.nnz() == int(mask.sum()) * 5
+
+
+def test_strided_conv3d_grads_flow():
+    pt.seed(8)
+    x = _random_sparse(vol=(1, 8, 8, 8), C=3, nsites=12, seed=13)
+    layer = Conv3D(3, 4, kernel_size=3, stride=2, padding=1)
+    out = layer(x)
+    (out.to_dense() ** 2).sum().backward()
+    g = np.asarray(layer.weight.grad._array)
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
 def test_subm_conv_grouped_or_strided_falls_back():
     """groups>1 routes through the dense-masked path and still matches."""
     pt.seed(3)

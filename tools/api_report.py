@@ -28,9 +28,8 @@ SHIMS = {
     "paddle.text": {"Imdb", "Imikolov", "Movielens", "UCIHousing",
                     "WMT14", "WMT16", "Conll05st"},   # no-network corpora
     "paddle.hub": {"load", "list", "help"},     # local-source only
-    # dense-backed compute behind a sparse surface (SubmConv3D/BatchNorm/
-    # ReLU are REAL sparse compute since round 4)
-    "paddle.sparse.nn": {"Conv3D"},
+    # sparse.nn is fully real since round 4 (SubmConv3D + strided Conv3D
+    # gather/einsum/scatter, BatchNorm over values) — no shims left there
 }
 
 
