@@ -1,0 +1,93 @@
+"""Point-cloud training with sparse.nn (reference workflow: paddle.sparse
+voxel pipelines — SubmConv3D/Conv3D/BatchNorm/ReLU over COO voxels).
+
+Builds a tiny sparse voxel classifier: two submanifold conv blocks
+(pattern-preserving), one strided sparse conv (downsampling the active
+sites), global pooling over stored values, and a dense head.  All conv
+compute is gather -> stacked-einsum -> scatter over the ACTIVE sites —
+FLOPs scale with occupancy, not with the 32^3 volume.
+
+    python examples/pointcloud_sparse.py [--cpu] [--steps N]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def random_cloud(rng, n_classes=4, vol=32, nsites=256, C=4):
+    """Synthetic 'shapes': each class concentrates sites along a
+    different axis-aligned slab so the task is learnable."""
+    y = rng.randint(n_classes)
+    axis = y % 3
+    center = vol // 4 + (y // 3) * vol // 2
+    coords = rng.randint(0, vol, size=(nsites, 3))
+    coords[:, axis] = np.clip(
+        rng.randint(center - 3, center + 3, size=nsites), 0, vol - 1)
+    coords = np.unique(coords, axis=0)
+    feats = rng.randn(len(coords), C).astype(np.float32)
+    return coords, feats, y
+
+
+def to_coo(pt, sparse, coords, feats, vol, C):
+    n = np.zeros((len(coords), 1), np.int64)
+    site_idx = np.concatenate([n, coords], axis=1)     # [S, 4]
+    idx = np.repeat(site_idx, C, axis=0)
+    ch = np.tile(np.arange(C), len(coords))[:, None]
+    indices = np.concatenate([idx, ch], axis=1).T       # [5, S*C]
+    return sparse.sparse_coo_tensor(indices, feats.reshape(-1),
+                                    shape=(1, vol, vol, vol, C))
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="sparse voxel classifier (SubmConv3D/Conv3D stack)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend")
+    ap.add_argument("--steps", type=int, default=30)
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as pt
+    from paddle_tpu import sparse
+    from paddle_tpu.sparse import nn as spnn
+    import paddle_tpu.nn.functional as F
+
+    VOL, C, NCLS = 32, 4, 4
+    pt.seed(0)
+    net = [spnn.SubmConv3D(C, 16, kernel_size=3),
+           spnn.BatchNorm(16), spnn.ReLU(),
+           spnn.SubmConv3D(16, 16, kernel_size=3),
+           spnn.BatchNorm(16), spnn.ReLU(),
+           spnn.Conv3D(16, 32, kernel_size=3, stride=2, padding=1)]
+    head = pt.nn.Linear(32, NCLS)
+    params = [p for layer in net for p in layer.parameters()] \
+        + list(head.parameters())
+    opt = pt.optimizer.Adam(learning_rate=2e-3, parameters=params)
+
+    rng = np.random.RandomState(0)
+    for step in range(args.steps):
+        coords, feats, y = random_cloud(rng, NCLS, VOL)
+        x = to_coo(pt, sparse, coords, feats, VOL, C)
+        for layer in net:
+            x = layer(x)
+        # global mean over stored values per channel (values-only, like
+        # the point-cloud pooling heads)
+        vals = x.values().reshape([-1, 32])
+        logits = head(vals.mean(axis=0, keepdim=True))
+        loss = F.cross_entropy(logits, pt.to_tensor(np.array([y])))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if step % 10 == 0 or step == args.steps - 1:
+            print(f"step {step:2d}  sites={x.nnz() // 32:4d}  "
+                  f"loss={float(loss):.4f}")
+    print("done — sparse conv stack trains end-to-end")
+
+
+if __name__ == "__main__":
+    main()

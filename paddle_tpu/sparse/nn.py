@@ -98,7 +98,6 @@ def _prep_sparse_conv(b, kdims, stride, pad, dil, subm):
     via searchsorted.  Index work is O((S_in + S_out) * K log S) ints —
     no dense volume is ever touched.  Returns None when the volume
     overflows int32 keys (caller falls back to the dense path)."""
-    import jax
     N, Dd, H, W, _C = b.shape
     kd, kh, kw = kdims
     sd, sh, sw = stride
@@ -262,12 +261,19 @@ class Conv3D(Layer):
             ins.append(self.bias)
         out = engine.apply("sparse_conv3d", conv_fn, ins)
 
+        # occupancy comes from the STORED INDEX PATTERN, not |values|>0
+        # (stored-zero entries are routine after sparse ReLU; the sparse
+        # path and the reference both dilate the pattern) — scatter ones
+        # at the stored sites
+        bco = _coo(x)
+        site_idx = bco.indices[:, :4]
+        occ = jnp.zeros(bco.shape[:4], jnp.float32).at[
+            tuple(site_idx.T)].set(1.0)
         if self._subm:
-            mask = (jnp.abs(dense).sum(axis=-1, keepdims=True) > 0)
+            mask = (occ > 0)[..., None]
         else:
-            # occupancy dilation decides the output pattern; always a
+            # pattern dilation decides the output sites; always a
             # single-channel ungrouped conv regardless of self.groups
-            occ = (jnp.abs(dense).sum(axis=-1) > 0).astype(jnp.float32)
             occ_out = conv_fn(
                 occ[..., None],
                 jnp.ones(self.weight._array.shape[:3] + (1, 1),
