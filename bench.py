@@ -594,17 +594,32 @@ def main():
 
     # ---- secondary legs (stderr json so the driver tail records them)
     if _left() > 400:
-        res = _spawn({"kind": "resnet",
-                      "batch": int(os.environ.get("BENCH_RESNET_BATCH",
-                                                  "256"))},
-                     min(PRESET_TIMEOUT, _left()))
-        if res:
+        # layout A/B inside the leg (VERDICT r3 item 3): measure BOTH
+        # data formats and report the better — the chip may only be up
+        # for this one driver-run, so the choice can't depend on a
+        # pre-tuned env var from an earlier session
+        batch = int(os.environ.get("BENCH_RESNET_BATCH", "256"))
+        fmt_res = {}
+        for fmt in ("NHWC", "NCHW"):
+            if _left() < 350:
+                break
+            r = _spawn({"kind": "resnet", "batch": batch, "steps": 12,
+                        "data_format": fmt}, min(PRESET_TIMEOUT, _left()))
+            if r:
+                fmt_res[fmt] = r
+        if fmt_res:
+            best_fmt = max(fmt_res, key=lambda f: fmt_res[f]["ips"])
+            res = dict(fmt_res[best_fmt], data_format=best_fmt,
+                       ips_by_format={f: round(r["ips"], 1)
+                                      for f, r in fmt_res.items()})
             record["legs"]["resnet"] = res
             _log(json.dumps({
                 "metric": "ResNet-50 train images/sec/chip",
                 "value": round(res["ips"], 1), "unit": "images/s/chip",
                 "vs_baseline": round(res["ips"] / A100_RESNET50_IMG_PER_SEC,
-                                     3)}))
+                                     3),
+                "data_format": best_fmt,
+                "ips_by_format": res["ips_by_format"]}))
     if _left() > 400:
         res = _spawn({"kind": "llama"}, min(PRESET_TIMEOUT, _left()))
         if res:
