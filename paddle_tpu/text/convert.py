@@ -1,0 +1,193 @@
+"""HuggingFace checkpoint interop (reference analog: PaddleNLP's
+from_pretrained weight conversion from torch checkpoints).
+
+Converts `transformers` state dicts into this framework's LLaMA / BERT /
+GPT-2 models, in place.  Works from either an HF model instance or its
+`state_dict()`; tensors may be torch tensors or numpy arrays (no network
+needed — HF models constructed locally convert fine, which is also how
+the parity tests pin our transformer blocks against torch's reference
+implementations to ~1e-5).
+
+Layout notes (the load-bearing differences):
+  * torch nn.Linear stores [out, in]; our Linear stores [in, out] — all
+    dense weights transpose (GPT-2's Conv1D is ALREADY [in, out]).
+  * HF LLaMA applies rotary position embeddings in half-split layout
+    (rotate_half: pairs (i, i + d/2)); ours is interleaved (GPT-J
+    pairs (2i, 2i+1)).  q/k projection rows permute per head so the
+    two formulations produce identical attention.
+  * our GPT ties lm_head to wte (like GPT-2); HF LLaMA has a separate
+    lm_head that we transpose into ours.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = ["convert_hf_llama", "convert_hf_bert", "convert_hf_gpt2"]
+
+
+def _np(t):
+    """torch tensor / np array -> float32 numpy (handles bf16 tensors,
+    the standard dtype of published checkpoints — numpy has no bfloat16,
+    so upcast in torch first)."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def _state(hf):
+    if hasattr(hf, "state_dict"):
+        return {k: _np(v) for k, v in hf.state_dict().items()}
+    return {k: _np(v) for k, v in hf.items()}
+
+
+def _check_layer_count(sd, pattern, n_target, arch):
+    """A checkpoint with more layers than the target silently converting
+    its prefix would be a correctness trap — fail loudly instead."""
+    layers = {int(m.group(1)) for k in sd
+              for m in [re.match(pattern, k)] if m}
+    if layers and max(layers) + 1 != n_target:
+        raise ValueError(
+            f"convert_{arch}: source checkpoint has {max(layers) + 1} "
+            f"layers but the target model has {n_target} — configure the "
+            f"target to match the checkpoint")
+
+
+def _assign(model, mapping):
+    params = dict(model.named_parameters())
+    missing = [k for k in mapping if k not in params]
+    if missing:
+        raise KeyError(f"convert: no such target params {missing[:4]}")
+    for name, arr in mapping.items():
+        p = params[name]
+        if tuple(p.shape) != arr.shape:
+            raise ValueError(
+                f"convert: {name} shape {tuple(p.shape)} != source "
+                f"{arr.shape}")
+        p._inplace_assign(jnp.asarray(arr, p._array.dtype))
+    return model
+
+
+def _rope_perm(w_out_in, n_heads, head_dim):
+    """Reorder torch [out, in] q/k rows from HF half-split rope layout to
+    our interleaved layout: our row 2i <- HF row i, 2i+1 <- i + d/2."""
+    perm = np.empty(head_dim, np.int64)
+    half = head_dim // 2
+    perm[0::2] = np.arange(half)
+    perm[1::2] = np.arange(half) + half
+    w = w_out_in.reshape(n_heads, head_dim, -1)[:, perm]
+    return w.reshape(n_heads * head_dim, -1)
+
+
+def convert_hf_llama(model, hf):
+    """transformers Llama{Model,ForCausalLM} (or its state_dict) -> our
+    LlamaForCausalLM."""
+    sd = _state(hf)
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    cfg = model.cfg
+    _check_layer_count(sd, rf"{re.escape(pre)}layers\.(\d+)\.",
+                       cfg.num_layers, "hf_llama")
+    dh = cfg.hidden_size // cfg.num_heads
+    out = {"llama.embed_tokens.weight": sd[pre + "embed_tokens.weight"],
+           "llama.norm.weight": sd[pre + "norm.weight"]}
+    # tied/stripped checkpoints (safetensors drops shared lm_head): our
+    # head is a separate param, so materialize the tie from wte rather
+    # than silently leaving it at random init
+    head = sd.get("lm_head.weight",
+                  sd[pre + "embed_tokens.weight"])
+    out["lm_head.weight"] = head.T
+    for i in range(cfg.num_layers):
+        h, o = f"{pre}layers.{i}.", f"llama.layers.{i}."
+        out[o + "input_layernorm.weight"] = sd[h + "input_layernorm.weight"]
+        out[o + "post_attention_layernorm.weight"] = \
+            sd[h + "post_attention_layernorm.weight"]
+        out[o + "self_attn.q_proj.weight"] = _rope_perm(
+            sd[h + "self_attn.q_proj.weight"], cfg.num_heads, dh).T
+        out[o + "self_attn.k_proj.weight"] = _rope_perm(
+            sd[h + "self_attn.k_proj.weight"], cfg.num_kv_heads, dh).T
+        out[o + "self_attn.v_proj.weight"] = \
+            sd[h + "self_attn.v_proj.weight"].T
+        out[o + "self_attn.o_proj.weight"] = \
+            sd[h + "self_attn.o_proj.weight"].T
+        for w in ("gate_proj", "up_proj", "down_proj"):
+            out[o + f"mlp.{w}.weight"] = sd[h + f"mlp.{w}.weight"].T
+    return _assign(model, out)
+
+
+def convert_hf_bert(model, hf):
+    """transformers Bert{Model,For*} (or state_dict) -> our BERT-bearing
+    model (anything exposing `bert.*` params, e.g.
+    BertForSequenceClassification; task heads are left untouched)."""
+    sd = _state(hf)
+    pre = "bert." if any(k.startswith("bert.") for k in sd) else ""
+    _check_layer_count(sd, rf"{re.escape(pre)}encoder\.layer\.(\d+)\.",
+                       model.bert.cfg.num_hidden_layers, "hf_bert")
+    emb = pre + "embeddings."
+    out = {
+        "bert.embeddings.word_embeddings.weight":
+            sd[emb + "word_embeddings.weight"],
+        "bert.embeddings.position_embeddings.weight":
+            sd[emb + "position_embeddings.weight"],
+        "bert.embeddings.token_type_embeddings.weight":
+            sd[emb + "token_type_embeddings.weight"],
+        "bert.embeddings.layer_norm.weight": sd[emb + "LayerNorm.weight"],
+        "bert.embeddings.layer_norm.bias": sd[emb + "LayerNorm.bias"],
+    }
+    if pre + "pooler.dense.weight" in sd:
+        out["bert.pooler.weight"] = sd[pre + "pooler.dense.weight"].T
+        out["bert.pooler.bias"] = sd[pre + "pooler.dense.bias"]
+    n_layers = model.bert.cfg.num_hidden_layers
+    for i in range(n_layers):
+        h, o = pre + f"encoder.layer.{i}.", f"bert.encoder.layers.{i}."
+        att = h + "attention."
+        pairs = [
+            (o + "self_attn.q_proj", att + "self.query"),
+            (o + "self_attn.k_proj", att + "self.key"),
+            (o + "self_attn.v_proj", att + "self.value"),
+            (o + "self_attn.out_proj", att + "output.dense"),
+            (o + "linear1", h + "intermediate.dense"),
+            (o + "linear2", h + "output.dense"),
+        ]
+        for ours, theirs in pairs:
+            out[ours + ".weight"] = sd[theirs + ".weight"].T
+            out[ours + ".bias"] = sd[theirs + ".bias"]
+        out[o + "norm1.weight"] = sd[att + "output.LayerNorm.weight"]
+        out[o + "norm1.bias"] = sd[att + "output.LayerNorm.bias"]
+        out[o + "norm2.weight"] = sd[h + "output.LayerNorm.weight"]
+        out[o + "norm2.bias"] = sd[h + "output.LayerNorm.bias"]
+    return _assign(model, out)
+
+
+def convert_hf_gpt2(model, hf):
+    """transformers GPT2{Model,LMHeadModel} (or state_dict) -> our
+    GPTForCausalLM.  GPT-2's Conv1D already stores [in, out], so the
+    fused c_attn maps straight onto our fused qkv_proj (same [q|k|v]
+    column order); the head stays weight-tied to wte on both sides."""
+    sd = _state(hf)
+    pre = "transformer." if any(k.startswith("transformer.")
+                                for k in sd) else ""
+    cfg = model.cfg
+    _check_layer_count(sd, rf"{re.escape(pre)}h\.(\d+)\.",
+                       cfg.num_layers, "hf_gpt2")
+    out = {"gpt.wte.weight": sd[pre + "wte.weight"],
+           "gpt.wpe.weight": sd[pre + "wpe.weight"],
+           "gpt.ln_f.weight": sd[pre + "ln_f.weight"],
+           "gpt.ln_f.bias": sd[pre + "ln_f.bias"]}
+    for i in range(cfg.num_layers):
+        h, o = f"{pre}h.{i}.", f"gpt.h.{i}."
+        out[o + "ln_1.weight"] = sd[h + "ln_1.weight"]
+        out[o + "ln_1.bias"] = sd[h + "ln_1.bias"]
+        out[o + "ln_2.weight"] = sd[h + "ln_2.weight"]
+        out[o + "ln_2.bias"] = sd[h + "ln_2.bias"]
+        out[o + "attn.qkv_proj.weight"] = sd[h + "attn.c_attn.weight"]
+        out[o + "attn.qkv_proj.bias"] = sd[h + "attn.c_attn.bias"]
+        out[o + "attn.out_proj.weight"] = sd[h + "attn.c_proj.weight"]
+        out[o + "attn.out_proj.bias"] = sd[h + "attn.c_proj.bias"]
+        out[o + "mlp.fc_in.weight"] = sd[h + "mlp.c_fc.weight"]
+        out[o + "mlp.fc_in.bias"] = sd[h + "mlp.c_fc.bias"]
+        out[o + "mlp.fc_out.weight"] = sd[h + "mlp.c_proj.weight"]
+        out[o + "mlp.fc_out.bias"] = sd[h + "mlp.c_proj.bias"]
+    return _assign(model, out)
