@@ -20,7 +20,7 @@ from .ernie import (  # noqa: F401
 )
 from .generation import generate, beam_search  # noqa: F401
 from .convert import (  # noqa: F401
-    convert_hf_llama, convert_hf_bert, convert_hf_gpt2)
+    convert_hf_llama, convert_hf_bert, convert_hf_gpt2, convert_hf_ernie)
 from .viterbi import viterbi_decode, ViterbiDecoder  # noqa: F401
 from .transformer_mt import (  # noqa: F401
     TransformerModel, transformer_mt_loss, sinusoidal_positions,

@@ -26,7 +26,8 @@ import numpy as np
 
 import jax.numpy as jnp
 
-__all__ = ["convert_hf_llama", "convert_hf_bert", "convert_hf_gpt2"]
+__all__ = ["convert_hf_llama", "convert_hf_bert", "convert_hf_gpt2",
+           "convert_hf_ernie"]
 
 
 def _np(t):
@@ -191,3 +192,19 @@ def convert_hf_gpt2(model, hf):
         out[o + "mlp.fc_out.weight"] = sd[h + "mlp.c_proj.weight"]
         out[o + "mlp.fc_out.bias"] = sd[h + "mlp.c_proj.bias"]
     return _assign(model, out)
+
+
+def convert_hf_ernie(model, hf):
+    """transformers Ernie{Model,For*} (or state_dict) -> our ERNIE-bearing
+    model (ErnieModel or Ernie task heads).  ERNIE is the BERT layout
+    plus task-type embeddings, so the BERT mapping does the body and the
+    task embedding rides on top."""
+    sd = _state(hf)
+    pre = "ernie." if any(k.startswith("ernie.") for k in sd) else ""
+    sub = {k[len(pre):]: v for k, v in sd.items()} if pre else sd
+    core = model.ernie if hasattr(model, "ernie") else model
+    convert_hf_bert(core, sub)
+    tt = "embeddings.task_type_embeddings.weight"
+    if tt in sub and getattr(core.cfg, "use_task_id", False):
+        _assign(core, {"task_type_embeddings.weight": sub[tt]})
+    return model

@@ -114,6 +114,44 @@ def test_gpt2_matches_transformers_and_greedy_decode():
         cur_ours = np.concatenate([cur_ours, nt_ours[:, None]], 1)
 
 
+def test_ernie_matches_transformers():
+    """ERNIE = BERT layout + task-type embeddings; the converter
+    delegates the body to the BERT mapping."""
+    from paddle_tpu.text.ernie import (ErnieConfig,
+                                       ErnieForSequenceClassification)
+    from paddle_tpu.text.convert import convert_hf_ernie
+    from transformers import ErnieConfig as HFC, ErnieModel as HFM
+
+    torch.manual_seed(0)
+    hf = HFM(HFC(vocab_size=100, hidden_size=32, num_hidden_layers=2,
+                 num_attention_heads=2, intermediate_size=64,
+                 max_position_embeddings=32, type_vocab_size=2,
+                 task_type_vocab_size=3, use_task_id=True,
+                 hidden_dropout_prob=0.0,
+                 attention_probs_dropout_prob=0.0)).eval()
+    pt.seed(0)
+    ours = ErnieForSequenceClassification(ErnieConfig(
+        vocab_size=100, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=32, task_type_vocab_size=3,
+        use_task_id=True, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0), num_classes=2)
+    ours.eval()
+    convert_hf_ernie(ours, hf)
+
+    ids = np.random.RandomState(0).randint(0, 100, (2, 10))
+    tt = np.zeros((2, 10), np.int64)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids), task_type_ids=torch.tensor(tt))
+    seq, pooled = ours.ernie(pt.to_tensor(ids))
+    np.testing.assert_allclose(np.asarray(seq._array),
+                               ref.last_hidden_state.numpy(),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(pooled._array),
+                               ref.pooler_output.numpy(),
+                               rtol=2e-4, atol=2e-5)
+
+
 def test_convert_rejects_layer_count_mismatch():
     """A deeper checkpoint must not silently convert its prefix."""
     from paddle_tpu.text.llama import LlamaConfig, LlamaForCausalLM
