@@ -155,7 +155,11 @@ def run_gpt(preset, seq_len, batch, steps=20, warmup=3, **cfg_kw):
         preset, vocab_size=50304, max_position_embeddings=seq_len,
         hidden_dropout=0.0, attention_dropout=0.0, tensor_parallel=False,
         **cfg_kw)
-    model = GPTForCausalLM(cfg)
+    # LazyGuard: the whole init is ONE jitted program — eager construction
+    # costs ~3 device round-trips per parameter, which over the tunneled
+    # TPU stalled the large legs for entire preset timeouts (round 4)
+    with pt.LazyGuard():
+        model = GPTForCausalLM(cfg)
     # pure bf16 (AMP O2, no fp32 master): Adafactor's factored state keeps
     # optimizer memory negligible so the 1.3B preset fits one chip's HBM
     opt = pt.optimizer.Adafactor(learning_rate=1e-4,
@@ -230,7 +234,8 @@ def run_resnet(batch=256, steps=20, warmup=3, s2d_stem=True,
         raise ValueError(f"BENCH_RESNET_FORMAT must be NCHW or NHWC, "
                          f"got {data_format!r}")
     pt.seed(0)
-    model = resnet50(num_classes=1000, s2d_stem=s2d_stem,
+    with pt.LazyGuard():
+        model = resnet50(num_classes=1000, s2d_stem=s2d_stem,
                      data_format=data_format)
     opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                                 parameters=model.parameters())
@@ -281,7 +286,8 @@ def run_llama(steps=10, warmup=2, hidden=2048, layers=16, heads=16,
                       intermediate_size=inter,
                       max_position_embeddings=seq, use_recompute=True,
                       tensor_parallel=n > 1)
-    model = LlamaForCausalLM(cfg)
+    with pt.LazyGuard():
+        model = LlamaForCausalLM(cfg)
     opt = pt.optimizer.Adafactor(learning_rate=1e-4,
                                  parameters=model.parameters())
     model, opt = pt.amp.decorate(models=model, optimizers=opt,
@@ -328,7 +334,8 @@ def run_bert(steps=20, warmup=3, batch=32, seq=128):
 
     pt.seed(0)
     cfg = BertConfig(hidden_dropout_prob=0.1)   # bert-base defaults
-    model = BertForSequenceClassification(cfg, num_classes=2)
+    with pt.LazyGuard():
+        model = BertForSequenceClassification(cfg, num_classes=2)
     opt = pt.optimizer.AdamW(learning_rate=2e-5,
                              parameters=model.parameters())
     model, opt = pt.amp.decorate(models=model, optimizers=opt,
@@ -370,7 +377,8 @@ def run_ernie_infer(steps=30, warmup=5, batch=32, seq=128,
 
     pt.seed(0)
     cfg = ernie_config_from_preset(preset, hidden_dropout_prob=0.0)
-    model = ErnieForSequenceClassification(cfg, num_classes=2)
+    with pt.LazyGuard():
+        model = ErnieForSequenceClassification(cfg, num_classes=2)
     model.eval()
     with tempfile.TemporaryDirectory() as d:
         path = _os.path.join(d, "ernie_deploy")

@@ -21,6 +21,7 @@ from .tensor import Tensor, parameter  # noqa: F401
 from .tensor_api import *  # noqa: F401,F403
 from .tensor_api import to_tensor, seed  # noqa: F401
 from .autograd import no_grad, enable_grad, set_grad_enabled, grad  # noqa: F401
+from .framework.lazy import LazyGuard  # noqa: F401
 from .autograd import backward as _backward  # noqa: F401
 from . import autograd  # noqa: F401
 from . import amp  # noqa: F401

@@ -71,12 +71,22 @@ def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16",
     target = dtypes.convert_dtype(dtype)
     single = not isinstance(models, (list, tuple))
     model_list = [models] if single else list(models)
+    # batch ALL casts into one jitted call: per-param eager .astype costs a
+    # device round-trip each, which on a tunneled TPU dominates large-model
+    # setup time (round-4 bench stall diagnosis)
+    to_cast = []
     for m in model_list:
         if m is None:
             continue
         for p in m.parameters():
             if jnp.issubdtype(p._array.dtype, jnp.floating):
-                p._inplace_assign(p._array.astype(target))
+                to_cast.append(p)
+    if to_cast:
+        import jax
+        casted = jax.jit(lambda xs: [x.astype(target) for x in xs])(
+            [p._array for p in to_cast])
+        for p, arr in zip(to_cast, casted):
+            p._inplace_assign(arr)
     if optimizers is None:
         return models if single else model_list
     opt_single = not isinstance(optimizers, (list, tuple))

@@ -1,0 +1,130 @@
+"""Deferred ("lazy") parameter initialization — paddle.LazyGuard.
+
+Reference surface: paddle.LazyGuard (python/paddle/nn/initializer/lazy_init.py):
+layers constructed under the guard do not allocate or initialize their
+parameters at construction time.
+
+TPU-native rationale (why this is a *performance* feature here, not just
+API parity): on a remote / tunneled accelerator every eager op pays a
+host<->device round-trip.  Constructing a billion-parameter model eagerly
+costs ~3 dispatches per parameter (zeros + PRNG-key split + sample), i.e.
+thousands of round-trips before training can even start.  Under LazyGuard,
+``Layer.create_parameter`` records (placeholder, initializer) pairs and the
+guard's exit materializes EVERY parameter in ONE jitted XLA program: one
+trace, one compile, one execution, and the weights are born on-device —
+nothing crosses the wire but the program and a single PRNG key.
+
+Determinism contract: the jitted init program consumes the global PRNG
+key *as of materialization*, draws per-parameter subkeys through the same
+``framework.random.next_key`` split chain the eager path uses, and writes
+the evolved key back afterwards — so ``seed(k); with LazyGuard(): M()``
+and ``seed(k); M()`` draw the identical subkey sequence and leave the RNG
+in the same state, provided no OTHER rng draw (``pt.rand``, ``pt.seed``,
+a forward pass) happens inside the guard.  Interleaved draws keep full
+determinism (same seed -> same values) but reorder the chain relative to
+eager construction, so eager-order parity no longer holds for that run.
+Values match eager construction up to op-fusion rounding (XLA fuses
+``sample*std+mean`` into an FMA under jit), i.e. within 1 ulp.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import random as _random
+
+_STATE = {"depth": 0, "pending": [], "aliases": []}
+
+
+def active() -> bool:
+    """True while inside at least one LazyGuard."""
+    return _STATE["depth"] > 0
+
+
+def defer(tensor, shape, dtype, init_fn):
+    """Record a parameter whose init is postponed to guard exit.
+
+    The tensor's ``_array`` becomes a ShapeDtypeStruct placeholder so shape /
+    dtype / size / ndim stay readable during construction (layers read these
+    to build sublayers); any *compute* on it before materialization raises,
+    which is the same contract as the reference's LazyGuard.
+    """
+    shape = tuple(int(s) for s in shape)
+    tensor._array = jax.ShapeDtypeStruct(shape, jnp.dtype(dtype))
+    _STATE["pending"].append((tensor, shape, jnp.dtype(dtype), init_fn))
+    return tensor
+
+
+def defer_alias(copy_tensor, src_tensor):
+    """Register a deep-copied placeholder (``copy.deepcopy`` of a lazy
+    parameter — e.g. TransformerEncoder cloning its prototype layer).
+    Deepcopy semantics require the copy to hold the SAME values as its
+    source, so materialization assigns the source's concrete array to the
+    copy rather than drawing fresh randomness."""
+    _STATE["aliases"].append((copy_tensor, src_tensor))
+    return copy_tensor
+
+
+def materialize(pending=None, aliases=None):
+    """Run every deferred initializer in ONE jitted program and assign the
+    concrete on-device results back onto their tensors."""
+    from ..tensor import Tensor
+
+    if pending is None:
+        pending, _STATE["pending"] = _STATE["pending"], []
+    if aliases is None:
+        aliases, _STATE["aliases"] = _STATE["aliases"], []
+    if not pending and not aliases:
+        return 0
+
+    def _build(root_key):
+        with _random.key_context(root_key):
+            outs = []
+            for _, shape, dtype, init in pending:
+                tmp = Tensor._from_array(jnp.zeros(shape, dtype))
+                init(tmp)  # initializers swap tmp._array under trace
+                outs.append(tmp._array)
+            evolved = _random._key_stack[-1]
+        return outs, evolved
+
+    if pending:
+        # the key rides in as an ARGUMENT (not a baked constant) so XLA
+        # cannot constant-fold the whole init program at compile time
+        arrays, evolved = jax.jit(_build)(_random.default_key())
+        _random._state["key"] = evolved
+        for (t, _, _, _), arr in zip(pending, arrays):
+            t._array = arr
+    # registration order guarantees an alias's source (original or earlier
+    # alias) is resolved before the alias itself; each alias then gets an
+    # INDEPENDENT device-side copy in one batched call — fused train steps
+    # donate param buffers, so aliases must not share them
+    for copy_t, src_t in aliases:
+        copy_t._array = src_t._array
+    if aliases:
+        copies = jax.jit(lambda xs: [jnp.copy(x) for x in xs])(
+            [c._array for c, _ in aliases])
+        for (copy_t, _), arr in zip(aliases, copies):
+            copy_t._array = arr
+    return len(pending) + len(aliases)
+
+
+class LazyGuard:
+    """``with paddle.LazyGuard(): model = Net()`` — delayed parameter init.
+
+    Nesting is allowed; materialization happens when the OUTERMOST guard
+    exits cleanly.  If construction raises, the pending list is dropped
+    (half-built layers are not materialized).
+    """
+
+    def __enter__(self):
+        _STATE["depth"] += 1
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        _STATE["depth"] -= 1
+        if _STATE["depth"] == 0:
+            pending, _STATE["pending"] = _STATE["pending"], []
+            aliases, _STATE["aliases"] = _STATE["aliases"], []
+            if exc_type is None and (pending or aliases):
+                materialize(pending, aliases)
+        return False

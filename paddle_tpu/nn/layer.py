@@ -95,16 +95,26 @@ class Layer:
     def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
                          default_initializer=None):
         from . import initializer as I
+        from ..framework import lazy as _lazy
         dtype = dtypes.convert_dtype(dtype) or self._dtype
-        t = Tensor(jnp.zeros(tuple(int(s) for s in shape), dtype),
-                   stop_gradient=False)
-        t.persistable = True
         init = default_initializer
         if init is None and attr is not None and getattr(attr, "initializer", None):
             init = attr.initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
-        init(t)
+        if _lazy.active():
+            # LazyGuard: no device op now — record (placeholder, init) and
+            # let the guard's exit materialize everything in one jitted
+            # program (framework/lazy.py).  _from_array(None) never touches
+            # the device; defer() installs the ShapeDtypeStruct placeholder
+            t = Tensor._from_array(None, stop_gradient=False)
+            t.persistable = True
+            _lazy.defer(t, shape, dtype, init)
+        else:
+            t = Tensor(jnp.zeros(tuple(int(s) for s in shape), dtype),
+                       stop_gradient=False)
+            t.persistable = True
+            init(t)
         if attr is not None and hasattr(attr, "apply_to"):
             attr.apply_to(t)   # ParamAttr: name/trainable/lr coefficient
         return t

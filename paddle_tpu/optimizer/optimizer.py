@@ -93,14 +93,22 @@ class Optimizer:
         return {s: jnp.zeros_like(arr, dtype=jnp.float32) for s in self.SLOTS}
 
     def init_state(self, param_arrays):
-        state = []
-        for a in param_arrays:
-            slots = self._init_state_for(a)
-            if self._use_master_weights and a.dtype in (
-                    jnp.bfloat16, jnp.float16):
-                slots["master"] = a.astype(jnp.float32)
-            state.append(slots)
-        return state
+        # one jitted program for the WHOLE state tree: building slots
+        # eagerly costs a device round-trip per zeros/cast, which on a
+        # tunneled TPU turns large-model setup into minutes
+        import jax
+
+        def _build(arrs):
+            state = []
+            for a in arrs:
+                slots = self._init_state_for(a)
+                if self._use_master_weights and a.dtype in (
+                        jnp.bfloat16, jnp.float16):
+                    slots["master"] = a.astype(jnp.float32)
+                state.append(slots)
+            return state
+
+        return jax.jit(_build)(list(param_arrays))
 
     # -------------------------------------------------------- functional core
     def _rule(self, g, p, slots, lr, step):

@@ -229,6 +229,36 @@ class Tensor:
         self._array = array
         return self
 
+    def __deepcopy__(self, memo):
+        """Copies get an INDEPENDENT buffer (fused train steps donate param
+        buffers — donate_argnums in optimizer.py/train_step.py — so a copy
+        sharing the source's buffer would see 'Array has been deleted'
+        after the source's first step).  Under LazyGuard a deep-copied
+        placeholder (TransformerEncoder cloning its prototype layer) is
+        registered as an alias; materialization fills it with a device-side
+        copy of the source's values — deepcopy's identical-values
+        semantics without per-clone round-trips."""
+        import copy as _copy
+        cls = self.__class__
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        for k in self.__slots__:
+            if k == "__weakref__" or not hasattr(self, k):
+                continue
+            v = getattr(self, k)
+            if k == "_array":
+                setattr(new, k, v)  # shared iff lazy placeholder, see below
+            elif k == "_node":
+                setattr(new, k, None)  # autograd history does not clone
+            else:
+                setattr(new, k, _copy.deepcopy(v, memo))
+        from .framework import lazy as _lazy
+        if isinstance(self._array, jnp.ndarray):
+            new._array = jnp.copy(self._array)
+        elif _lazy.active():
+            _lazy.defer_alias(new, self)
+        return new
+
     # ------------------------------------------------------------- operators
     def _b(self, name, other, reverse=False):
         o = other if isinstance(other, Tensor) else Tensor._from_array(
