@@ -1,6 +1,7 @@
 """Autograd public API (reference: python/paddle/autograd/)."""
 from .engine import no_grad, enable_grad, set_grad_enabled, grad_enabled  # noqa: F401
 from .engine import run_backward  # noqa: F401
+from .engine import saved_tensors_hooks  # noqa: F401
 from .functional import grad, backward  # noqa: F401
 from .functional import jacobian, hessian, jvp, vjp  # noqa: F401
 from . import functional  # noqa: F401

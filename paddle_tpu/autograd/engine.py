@@ -75,11 +75,12 @@ class Node:
     __slots__ = (
         "name", "closed_fn", "parents", "vjp_fn", "seq",
         "out_refs", "out_shapes", "out_dtypes", "released", "tuple_out",
+        "saved",
         "__weakref__",
     )
 
     def __init__(self, name, closed_fn, parents, vjp_fn, outs,
-                 tuple_out=False):
+                 tuple_out=False, saved=None):
         self.name = name
         self.closed_fn = closed_fn
         self.parents = parents          # list[Tensor] (diff inputs, strong refs)
@@ -89,6 +90,7 @@ class Node:
         self.out_dtypes = [t._array.dtype for t in outs]
         self.released = False
         self.tuple_out = tuple_out
+        self.saved = saved              # saved_tensors_hooks deferred-vjp pack
         _seq_counter[0] += 1
         self.seq = _seq_counter[0]
 
@@ -96,6 +98,7 @@ class Node:
         self.vjp_fn = None
         self.closed_fn = None
         self.parents = ()
+        self.saved = None
         self.released = True
 
 
@@ -133,12 +136,32 @@ def apply(name, fn, tensor_args, consts=None):
             full[i] = a
         return fn(*full, **consts)
 
-    out, vjp_fn = jax.vjp(closed_fn, *[arrays[i] for i in diff_idx])
-    result = _wrap_out(out, stop_gradient=False)
-    outs = result if isinstance(result, tuple) else (result,)
-    tensor_outs = [t for t in outs if isinstance(t, Tensor)]
-    node = Node(name, closed_fn, [tensor_args[i] for i in diff_idx], vjp_fn,
-                tensor_outs, tuple_out=isinstance(out, tuple))
+    hooks = getattr(_tls, "saved_hooks", None)
+    if hooks:
+        # saved_tensors_hooks active: run the PLAIN forward (no vjp, so no
+        # on-device residuals are retained), pass each differentiable
+        # input through pack_hook, and defer the vjp — backward unpacks
+        # and re-traces (one recompute per op).  See saved_tensors_hooks.
+        pack_hook, unpack_hook = hooks[-1]
+        out = fn(*arrays, **consts)
+        packed = [pack_hook(Tensor._from_array(arrays[i]))
+                  for i in diff_idx]
+        nondiff = {i: arrays[i] for i in range(len(arrays))
+                   if i not in diff_idx}
+        result = _wrap_out(out, stop_gradient=False)
+        outs = result if isinstance(result, tuple) else (result,)
+        tensor_outs = [t for t in outs if isinstance(t, Tensor)]
+        node = Node(name, None, [tensor_args[i] for i in diff_idx], None,
+                    tensor_outs, tuple_out=isinstance(out, tuple),
+                    saved=(fn, dict(consts), nondiff, len(arrays),
+                           diff_idx, packed, unpack_hook))
+    else:
+        out, vjp_fn = jax.vjp(closed_fn, *[arrays[i] for i in diff_idx])
+        result = _wrap_out(out, stop_gradient=False)
+        outs = result if isinstance(result, tuple) else (result,)
+        tensor_outs = [t for t in outs if isinstance(t, Tensor)]
+        node = Node(name, closed_fn, [tensor_args[i] for i in diff_idx],
+                    vjp_fn, tensor_outs, tuple_out=isinstance(out, tuple))
     for k, t in enumerate(tensor_outs):
         if _is_diff_dtype(t._array.dtype):
             t._node = node
@@ -224,6 +247,12 @@ def run_backward(roots, root_grads, retain_graph=False, create_graph=False,
                 cots.append(c)
         if not any_live:
             continue
+        if node.saved is not None and (
+                node.closed_fn is None if create_graph
+                else node.vjp_fn is None):
+            # create_graph only needs closed_fn (_vjp_recorded re-traces
+            # its own vjp); building vjp_fn too would double the recompute
+            _rebuild_saved_vjp(node, with_vjp=not create_graph)
         if create_graph:
             grads = _vjp_recorded(node, cots)
         else:
@@ -309,6 +338,64 @@ def _apply_grad_hooks(t, c, create_graph):
             if g is not None:
                 c = g._array if isinstance(g, Tensor) else g
     return c
+
+
+def _rebuild_saved_vjp(node, with_vjp=True):
+    """Reconstitute a saved_tensors_hooks node's backward: unpack every
+    packed input and rebuild the closed function; with_vjp additionally
+    re-traces jax.vjp (the deferred forward recompute this feature trades
+    for released residual memory).  create_graph passes with_vjp=False
+    because _vjp_recorded re-traces its own vjp through closed_fn."""
+    from ..tensor import Tensor
+
+    fn, consts, nondiff, n_args, diff_idx, packed, unpack_hook = node.saved
+    unpacked = []
+    for obj in packed:
+        v = unpack_hook(obj)
+        unpacked.append(v._array if isinstance(v, Tensor) else jnp.asarray(v))
+
+    def closed_fn(*diff_arrays):
+        full = [None] * n_args
+        for i, a in nondiff.items():
+            full[i] = a
+        for i, a in zip(diff_idx, diff_arrays):
+            full[i] = a
+        return fn(*full, **consts)
+
+    node.closed_fn = closed_fn
+    if with_vjp:
+        _, vjp_fn = jax.vjp(closed_fn, *unpacked)
+        node.vjp_fn = vjp_fn
+    return node
+
+
+class saved_tensors_hooks:
+    """``paddle.autograd.saved_tensors_hooks(pack_hook, unpack_hook)``
+    (reference: python/paddle/autograd/saved_tensors_hooks.py).
+
+    TPU-native semantics: while active, recorded ops do NOT retain their
+    jax.vjp closure (whose residuals live in device HBM).  Each
+    differentiable input instead passes through ``pack_hook`` at record
+    time (e.g. ``lambda t: t.numpy()`` offloads to host); backward calls
+    ``unpack_hook`` and re-traces the vjp — one forward recompute per op.
+    Residual memory (softmax/exp outputs, matmul operands, ...) is
+    released immediately; note the tape's parent references still pin the
+    direct op-input tensors, so offload savings apply to the vjp
+    residuals, not the inputs themselves.
+    """
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook, self.unpack_hook = pack_hook, unpack_hook
+
+    def __enter__(self):
+        if not hasattr(_tls, "saved_hooks"):
+            _tls.saved_hooks = []
+        _tls.saved_hooks.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _tls.saved_hooks.pop()
+        return False
 
 
 def _vjp_recorded(node, cots):

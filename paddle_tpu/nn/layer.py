@@ -9,11 +9,26 @@ from __future__ import annotations
 
 from collections import OrderedDict
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .. import dtypes
 from ..tensor import Tensor
 from ..autograd import engine
+
+
+def _batched_cast_assign(tensors, values, dtypes_):
+    """Assign ``values[i]`` (cast to ``dtypes_[i]``, copied) onto
+    ``tensors[i]`` through ONE jitted call.  A device round-trip per tensor
+    is minutes of wall-clock for a large model over a tunneled TPU; the
+    copy also protects against a source model later donating its buffers
+    to a fused train step (aliasing would leave these tensors deleted)."""
+    vals = [v if isinstance(v, jax.Array) else np.asarray(v) for v in values]
+    out = jax.jit(lambda xs: [jnp.array(x, dtype=d, copy=True)
+                              for x, d in zip(xs, dtypes_)])(vals)
+    for t, arr in zip(tensors, out):
+        t._inplace_assign(arr)
 
 
 class Layer:
@@ -221,16 +236,17 @@ class Layer:
     def set_state_dict(self, state_dict, use_structured_name=True):
         own = self.state_dict()
         missing, unexpected = [], []
+        hits = []
         for k, v in state_dict.items():
             if k in own:
-                arr = v._array if isinstance(v, Tensor) else jnp.asarray(v)
-                # copy: the source model may later donate its buffers to a
-                # jitted step; aliasing would leave this model with deleted
-                # arrays
-                own[k]._inplace_assign(
-                    jnp.array(arr, dtype=own[k]._array.dtype, copy=True))
+                hits.append((k, v._array if isinstance(v, Tensor)
+                             else v))
             else:
                 unexpected.append(k)
+        if hits:
+            _batched_cast_assign([own[k] for k, _ in hits],
+                                 [a for _, a in hits],
+                                 [own[k]._array.dtype for k, _ in hits])
         for k in own:
             if k not in state_dict:
                 missing.append(k)
@@ -259,12 +275,11 @@ class Layer:
     def to(self, device=None, dtype=None, blocking=None):
         if dtype is not None:
             d = dtypes.convert_dtype(dtype)
-            for p in self.parameters():
-                if jnp.issubdtype(p._array.dtype, jnp.floating):
-                    p._inplace_assign(p._array.astype(d))
-            for b in self.buffers():
-                if jnp.issubdtype(b._array.dtype, jnp.floating):
-                    b._inplace_assign(b._array.astype(d))
+            targets = [t for t in list(self.parameters()) + list(self.buffers())
+                       if jnp.issubdtype(t._array.dtype, jnp.floating)]
+            if targets:
+                _batched_cast_assign(targets, [t._array for t in targets],
+                                     [d] * len(targets))
         return self
 
     def astype(self, dtype):
