@@ -106,6 +106,24 @@ def _is_diff_dtype(dtype) -> bool:
     return jnp.issubdtype(dtype, jnp.inexact)
 
 
+def add_op_observer(obs):
+    """Register a callable ``obs(name, tensor_args, consts, result)`` run
+    after every dispatched op (graph capture: onnx export, tooling)."""
+    if not hasattr(_tls, "op_observers"):
+        _tls.op_observers = []
+    _tls.op_observers.append(obs)
+    return obs
+
+
+def remove_op_observer(obs):
+    _tls.op_observers.remove(obs)
+
+
+def _notify(name, tensor_args, consts, result):
+    for obs in getattr(_tls, "op_observers", ()):
+        obs(name, tensor_args, consts, result)
+
+
 def apply(name, fn, tensor_args, consts=None):
     """Execute op `fn(*arrays, **consts)` on Tensor args, recording for backward.
 
@@ -128,6 +146,8 @@ def apply(name, fn, tensor_args, consts=None):
         result = _wrap_out(out, stop_gradient=True)
         if _static.enabled():
             _static.record_op(name, fn, tensor_args, consts, result)
+        if getattr(_tls, "op_observers", None):
+            _notify(name, tensor_args, consts, result)
         return result
 
     def closed_fn(*diff_arrays):
@@ -171,6 +191,8 @@ def apply(name, fn, tensor_args, consts=None):
             t.stop_gradient = True
     if _static.enabled():
         _static.record_op(name, fn, tensor_args, consts, result)
+    if getattr(_tls, "op_observers", None):
+        _notify(name, tensor_args, consts, result)
     return result
 
 
@@ -349,10 +371,6 @@ def _rebuild_saved_vjp(node, with_vjp=True):
     from ..tensor import Tensor
 
     fn, consts, nondiff, n_args, diff_idx, packed, unpack_hook = node.saved
-    unpacked = []
-    for obj in packed:
-        v = unpack_hook(obj)
-        unpacked.append(v._array if isinstance(v, Tensor) else jnp.asarray(v))
 
     def closed_fn(*diff_arrays):
         full = [None] * n_args
@@ -364,6 +382,11 @@ def _rebuild_saved_vjp(node, with_vjp=True):
 
     node.closed_fn = closed_fn
     if with_vjp:
+        unpacked = []
+        for obj in packed:
+            v = unpack_hook(obj)
+            unpacked.append(v._array if isinstance(v, Tensor)
+                            else jnp.asarray(v))
         _, vjp_fn = jax.vjp(closed_fn, *unpacked)
         node.vjp_fn = vjp_fn
     return node
@@ -382,6 +405,13 @@ class saved_tensors_hooks:
     released immediately; note the tape's parent references still pin the
     direct op-input tensors, so offload savings apply to the vjp
     residuals, not the inputs themselves.
+
+    create_graph semantics: a double-backward must stay graph-connected
+    to the ORIGINAL parents, so ``grad(..., create_graph=True)``
+    re-traces the vjp at the parents instead of the unpacked values.
+    With lossless hooks (the offload use case) the two coincide; lossy
+    pack/unpack (e.g. bf16 compression) is honored only on the plain
+    ``backward()`` path.
     """
 
     def __init__(self, pack_hook, unpack_hook):
@@ -405,6 +435,8 @@ def _vjp_recorded(node, cots):
     if node.closed_fn is None or any(
             getattr(c, "dtype", None) == jax.dtypes.float0 for c in cots):
         # PyLayer / int-output edge: plain (unrecorded) vjp on raw arrays
+        if node.vjp_fn is None and node.saved is not None:
+            _rebuild_saved_vjp(node)    # hooked node on the float0 path
         raw = [c._array if isinstance(c, Tensor) else c for c in cots]
         payload = tuple(raw) if node.tuple_out else raw[0]
         return node.vjp_fn(payload)

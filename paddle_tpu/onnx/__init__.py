@@ -1,0 +1,552 @@
+"""paddle.onnx — real ONNX export (reference: python/paddle/onnx/export.py,
+which delegates to the paddle2onnx converter).
+
+This environment ships no onnx/paddle2onnx packages, so the converter is
+implemented here from scratch: the eager op dispatch (autograd/engine.py
+``add_op_observer``) captures the layer's forward at PADDLE-OP granularity
+— which is already ONNX granularity (matmul, conv2d, layer_norm, ...) —
+and each captured op is emitted as ONNX NodeProto(s) through a
+per-op emitter table.  The wire format comes from a minimal ONNX IR
+protobuf subset (onnx_subset.proto, field numbers matching the public
+onnx.proto so standard tooling can read the files), compiled with protoc.
+
+``paddle_tpu.onnx.run`` is a self-contained numpy/jax evaluator over the
+emitted graphs — round-trip tests execute the serialized model and
+compare against the live layer without needing onnxruntime.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import onnx_subset_pb2 as P
+
+_DT = {
+    "float32": P.TensorProto.FLOAT, "float64": P.TensorProto.DOUBLE,
+    "float16": P.TensorProto.FLOAT16, "bfloat16": P.TensorProto.BFLOAT16,
+    "int32": P.TensorProto.INT32, "int64": P.TensorProto.INT64,
+    "int16": P.TensorProto.INT16, "int8": P.TensorProto.INT8,
+    "uint8": P.TensorProto.UINT8, "bool": P.TensorProto.BOOL,
+}
+
+
+def _np(arr):
+    return np.asarray(arr)
+
+
+def _tensor_proto(name, a):
+    a = _np(a)
+    t = P.TensorProto(name=name, data_type=_DT[str(a.dtype)],
+                      dims=list(a.shape))
+    t.raw_data = np.ascontiguousarray(a).tobytes()
+    return t
+
+
+def _value_info(name, shape, np_dtype, dynamic_axes=()):
+    vi = P.ValueInfoProto(name=name)
+    vi.type.tensor_type.elem_type = _DT[str(np.dtype(np_dtype))]
+    for i, d in enumerate(shape):
+        dim = vi.type.tensor_type.shape.dim.add()
+        if i in dynamic_axes:
+            dim.dim_param = f"dyn_{i}"
+        else:
+            dim.dim_value = int(d)
+    return vi
+
+
+class _Ctx:
+    """Graph under construction: value naming, initializers, node emit."""
+
+    def __init__(self, graph):
+        self.g = graph
+        self.names = {}          # id(jax array) -> value name
+        self._keep = []          # keep arrays alive so ids stay unique
+        self.n_tmp = 0
+        self.n_const = 0
+        self.initialized = set()
+
+    def fresh(self, hint="tmp"):
+        self.n_tmp += 1
+        return f"{hint}_{self.n_tmp}"
+
+    def name_of(self, arr, hint="const"):
+        """Value name for an array; unknown arrays become initializers."""
+        key = id(arr)
+        if key not in self.names:
+            self.n_const += 1
+            nm = f"{hint}_{self.n_const}"
+            self.g.initializer.append(_tensor_proto(nm, arr))
+            self.register(arr, nm)
+        return self.names[key]
+
+    def register(self, arr, name):
+        self.names[id(arr)] = name
+        self._keep.append(arr)
+
+    def add_init(self, name, np_array):
+        self.g.initializer.append(_tensor_proto(name, np_array))
+        return name
+
+    def node(self, op_type, inputs, outputs, **attrs):
+        n = self.g.node.add(op_type=op_type,
+                            name=f"{op_type}_{len(self.g.node)}")
+        n.input.extend(inputs)
+        n.output.extend(outputs)
+        for k, v in attrs.items():
+            a = n.attribute.add(name=k)
+            if isinstance(v, float):
+                a.type, a.f = P.AttributeProto.FLOAT, v
+            elif isinstance(v, bool) or isinstance(v, int):
+                a.type, a.i = P.AttributeProto.INT, int(v)
+            elif isinstance(v, str):
+                a.type, a.s = P.AttributeProto.STRING, v.encode()
+            elif isinstance(v, (list, tuple)):
+                if v and isinstance(v[0], float):
+                    a.type = P.AttributeProto.FLOATS
+                    a.floats.extend(v)
+                else:
+                    a.type = P.AttributeProto.INTS
+                    a.ints.extend(int(x) for x in v)
+            else:
+                raise TypeError(f"attr {k}={v!r}")
+        return n
+
+
+def _pair(v):
+    return [v, v] if isinstance(v, int) else list(v)
+
+
+# ------------------------------------------------------------- op emitters
+# each: emit(ctx, ins, consts, outs, arrs) where ins/outs are value names
+# and arrs the concrete input arrays (for shape-dependent decompositions)
+
+def _e_elementwise(onnx_op):
+    def emit(ctx, ins, consts, outs, arrs):
+        ctx.node(onnx_op, ins, outs)
+    return emit
+
+
+def _e_matmul(ctx, ins, consts, outs, arrs):
+    a, b = ins
+    if consts.get("transpose_x"):
+        a2 = ctx.fresh("mmTa")
+        perm = list(range(arrs[0].ndim))
+        perm[-1], perm[-2] = perm[-2], perm[-1]
+        ctx.node("Transpose", [a], [a2], perm=perm)
+        a = a2
+    if consts.get("transpose_y"):
+        b2 = ctx.fresh("mmTb")
+        perm = list(range(arrs[1].ndim))
+        perm[-1], perm[-2] = perm[-2], perm[-1]
+        ctx.node("Transpose", [b], [b2], perm=perm)
+        b = b2
+    ctx.node("MatMul", [a, b], outs)
+
+
+def _e_softmax(ctx, ins, consts, outs, arrs):
+    ctx.node("Softmax", ins, outs, axis=int(consts.get("axis", -1)))
+
+
+def _e_gelu(ctx, ins, consts, outs, arrs):
+    # decompose to Erf (opset>=9) so files load everywhere:
+    # gelu(x) = 0.5 * x * (1 + erf(x / sqrt(2)))
+    x = ins[0]
+    dt = _np(arrs[0]).dtype
+    inv = ctx.fresh("gelu_scaled")
+    ctx.node("Mul", [x, ctx.name_of(np.asarray(1.0 / np.sqrt(2.0), dt))],
+             [inv])
+    erf = ctx.fresh("gelu_erf")
+    ctx.node("Erf", [inv], [erf])
+    one = ctx.fresh("gelu_1p")
+    ctx.node("Add", [erf, ctx.name_of(np.asarray(1.0, dt))], [one])
+    half = ctx.fresh("gelu_half")
+    ctx.node("Mul", [x, one], [half])
+    ctx.node("Mul", [half, ctx.name_of(np.asarray(0.5, dt))], outs)
+
+
+def _e_layer_norm(ctx, ins, consts, outs, arrs):
+    nd = int(consts.get("normalized_ndim", 1))
+    ctx.node("LayerNormalization", ins, outs, axis=-nd,
+             epsilon=float(consts.get("eps", 1e-5)))
+
+
+def _e_conv2d(ctx, ins, consts, outs, arrs):
+    if consts.get("data_format", "NCHW") != "NCHW":
+        raise NotImplementedError("onnx export: conv2d NHWC")
+    ph, pw = _pair(consts.get("padding", 0))
+    ctx.node("Conv", ins, outs,
+             strides=_pair(consts.get("stride", 1)),
+             pads=[ph, pw, ph, pw],
+             dilations=_pair(consts.get("dilation", 1)),
+             group=int(consts.get("groups", 1)))
+
+
+def _e_bn_infer(ctx, ins, consts, outs, arrs):
+    ctx.node("BatchNormalization", ins, outs,
+             epsilon=float(consts.get("eps", 1e-5)))
+
+
+def _e_max_pool(ctx, ins, consts, outs, arrs):
+    ph, pw = _pair(consts.get("padding", 0))
+    ctx.node("MaxPool", ins, outs,
+             kernel_shape=_pair(consts["kernel_size"]),
+             strides=_pair(consts.get("stride") or consts["kernel_size"]),
+             pads=[ph, pw, ph, pw],
+             ceil_mode=int(bool(consts.get("ceil_mode", False))))
+
+
+def _e_avg_pool(ctx, ins, consts, outs, arrs):
+    ph, pw = _pair(consts.get("padding", 0))
+    ctx.node("AveragePool", ins, outs,
+             kernel_shape=_pair(consts["kernel_size"]),
+             strides=_pair(consts.get("stride") or consts["kernel_size"]),
+             pads=[ph, pw, ph, pw],
+             ceil_mode=int(bool(consts.get("ceil_mode", False))),
+             count_include_pad=int(not consts.get("exclusive", True)))
+
+
+def _e_adaptive_avg_pool(ctx, ins, consts, outs, arrs):
+    out_sz = consts.get("output_size")
+    if tuple(_pair(out_sz)) != (1, 1):
+        raise NotImplementedError(
+            f"onnx export: adaptive_avg_pool2d(output_size={out_sz}); only "
+            "(1, 1) (= GlobalAveragePool) maps to ONNX")
+    ctx.node("GlobalAveragePool", ins, outs)
+
+
+def _e_flatten(ctx, ins, consts, outs, arrs):
+    start = int(consts.get("start_axis", 0))
+    stop = int(consts.get("stop_axis", -1))
+    nd = _np(arrs[0]).ndim
+    if stop in (-1, nd - 1):
+        ctx.node("Flatten", ins, outs, axis=start)
+    else:
+        shape = list(_np(arrs[0]).shape)
+        merged = shape[:start] + [-1] + shape[stop + 1:]
+        sh = ctx.add_init(ctx.fresh("shape"),
+                          np.asarray(merged, np.int64))
+        ctx.node("Reshape", [ins[0], sh], outs)
+
+
+def _e_reshape(ctx, ins, consts, outs, arrs):
+    sh = ctx.add_init(ctx.fresh("shape"),
+                      np.asarray(list(consts["shape"]), np.int64))
+    ctx.node("Reshape", [ins[0], sh], outs)
+
+
+def _e_transpose(ctx, ins, consts, outs, arrs):
+    ctx.node("Transpose", ins, outs, perm=list(consts["perm"]))
+
+
+def _e_unsqueeze(ctx, ins, consts, outs, arrs):
+    ax = consts.get("axis", consts.get("axes", 0))
+    axes = ctx.add_init(ctx.fresh("axes"),
+                        np.asarray(_pair(ax)[:1] if isinstance(ax, int)
+                                   else list(ax), np.int64))
+    ctx.node("Unsqueeze", [ins[0], axes], outs)
+
+
+def _e_squeeze(ctx, ins, consts, outs, arrs):
+    ax = consts.get("axis", consts.get("axes", None))
+    inputs = [ins[0]]
+    if ax is not None:
+        inputs.append(ctx.add_init(
+            ctx.fresh("axes"),
+            np.asarray([ax] if isinstance(ax, int) else list(ax), np.int64)))
+    ctx.node("Squeeze", inputs, outs)
+
+
+def _e_concat(ctx, ins, consts, outs, arrs):
+    ctx.node("Concat", ins, outs, axis=int(consts.get("axis", 0)))
+
+
+def _e_embedding(ctx, ins, consts, outs, arrs):
+    ids = consts["ids"]
+    ids_name = ctx.names.get(id(ids))
+    if ids_name is None:
+        ids_name = ctx.name_of(np.asarray(ids, np.int64), "ids")
+    ctx.node("Gather", [ins[0], ids_name], outs, axis=0)
+
+
+def _e_cast(ctx, ins, consts, outs, arrs):
+    ctx.node("Cast", ins, outs,
+             to=int(_DT[str(np.dtype(consts["dtype"]))]))
+
+
+def _e_reduce(onnx_op, axes_as_input):
+    def emit(ctx, ins, consts, outs, arrs):
+        ax = consts.get("axis", None)
+        keep = int(bool(consts.get("keepdim", False)))
+        if ax is None:
+            axes = None
+        else:
+            axes = [ax] if isinstance(ax, int) else list(ax)
+        if axes_as_input:
+            inputs = [ins[0]]
+            if axes is not None:
+                inputs.append(ctx.add_init(ctx.fresh("axes"),
+                                           np.asarray(axes, np.int64)))
+            ctx.node(onnx_op, inputs, outs, keepdims=keep)
+        else:
+            kw = {"keepdims": keep}
+            if axes is not None:
+                kw["axes"] = axes
+            ctx.node(onnx_op, [ins[0]], outs, **kw)
+    return emit
+
+
+def _e_sdpa(ctx, ins, consts, outs, arrs):
+    """Scaled dot-product attention decomposition ([B, L, H, D] layout)."""
+    q, k, v = arrs[:3]
+    if q.shape[2] != k.shape[2]:
+        raise NotImplementedError("onnx export: GQA sdpa (H != H_kv)")
+    B, L, H, D = q.shape
+    dt = _np(q).dtype
+    scale = consts.get("scale") or 1.0 / float(np.sqrt(D))
+    qt = ctx.fresh("sdpa_q")   # [B, H, L, D]
+    ctx.node("Transpose", [ins[0]], [qt], perm=[0, 2, 1, 3])
+    kt = ctx.fresh("sdpa_kT")  # [B, H, D, L]
+    ctx.node("Transpose", [ins[1]], [kt], perm=[0, 2, 3, 1])
+    vt = ctx.fresh("sdpa_v")
+    ctx.node("Transpose", [ins[2]], [vt], perm=[0, 2, 1, 3])
+    logits = ctx.fresh("sdpa_logits")
+    ctx.node("MatMul", [qt, kt], [logits])
+    scaled = ctx.fresh("sdpa_scaled")
+    ctx.node("Mul", [logits, ctx.name_of(np.asarray(scale, dt))], [scaled])
+    if len(ins) > 3 and ins[3] is not None:       # additive mask input
+        masked = ctx.fresh("sdpa_masked")
+        ctx.node("Add", [scaled, ins[3]], [masked])
+        scaled = masked
+    if consts.get("is_causal"):
+        mask = np.triu(np.full((L, L), -1e9, dt), k=1)[None, None]
+        masked = ctx.fresh("sdpa_causal")
+        ctx.node("Add", [scaled, ctx.name_of(mask, "causal_mask")],
+                 [masked])
+        scaled = masked
+    probs = ctx.fresh("sdpa_probs")
+    ctx.node("Softmax", [scaled], [probs], axis=-1)
+    ot = ctx.fresh("sdpa_o")
+    ctx.node("MatMul", [probs, vt], [ot])
+    ctx.node("Transpose", [ot], outs, perm=[0, 2, 1, 3])
+
+
+def _e_getitem(ctx, ins, consts, outs, arrs):
+    index = consts["index"]
+    if not isinstance(index, tuple):
+        index = (index,)
+    nd = _np(arrs[0]).ndim
+    starts, ends, axes, steps, squeeze_axes = [], [], [], [], []
+    for ax, it in enumerate(index):
+        if isinstance(it, slice):
+            if it.start is None and it.stop is None and it.step is None:
+                continue
+            starts.append(it.start or 0)
+            ends.append(it.stop if it.stop is not None else 2**31 - 1)
+            axes.append(ax)
+            steps.append(it.step or 1)
+        elif isinstance(it, int):
+            starts.append(it)
+            ends.append(it + 1 if it != -1 else 2**31 - 1)
+            axes.append(ax)
+            steps.append(1)
+            squeeze_axes.append(ax)
+        else:
+            raise NotImplementedError(
+                f"onnx export: getitem index component {it!r}")
+    cur = ins[0]
+    if axes:
+        sl = ctx.fresh("sliced")
+        ctx.node("Slice", [
+            cur,
+            ctx.add_init(ctx.fresh("starts"), np.asarray(starts, np.int64)),
+            ctx.add_init(ctx.fresh("ends"), np.asarray(ends, np.int64)),
+            ctx.add_init(ctx.fresh("axes"), np.asarray(axes, np.int64)),
+            ctx.add_init(ctx.fresh("steps"), np.asarray(steps, np.int64)),
+        ], [sl])
+        cur = sl
+    if squeeze_axes:
+        sq = ctx.add_init(ctx.fresh("axes"),
+                          np.asarray(squeeze_axes, np.int64))
+        ctx.node("Squeeze", [cur, sq], outs)
+    elif axes:
+        ctx.g.node[-1].output[0] = outs[0]
+    else:
+        ctx.node("Identity", [cur], outs)
+    _ = nd
+
+
+def _e_scale(ctx, ins, consts, outs, arrs):
+    dt = _np(arrs[0]).dtype
+    s = float(consts.get("scale", 1.0))
+    b = float(consts.get("bias", 0.0))
+    cur = ins[0]
+    if s != 1.0:
+        nm = outs[0] if b == 0.0 else ctx.fresh("scaled")
+        ctx.node("Mul", [cur, ctx.name_of(np.asarray(s, dt))], [nm])
+        cur = nm
+    if b != 0.0 or s == 1.0:
+        ctx.node("Add", [cur, ctx.name_of(np.asarray(b, dt))], outs)
+
+
+_EMIT = {
+    "matmul": _e_matmul,
+    "add": _e_elementwise("Add"), "subtract": _e_elementwise("Sub"),
+    "multiply": _e_elementwise("Mul"), "divide": _e_elementwise("Div"),
+    "pow": _e_elementwise("Pow"), "maximum": _e_elementwise("Max"),
+    "minimum": _e_elementwise("Min"),
+    "relu": _e_elementwise("Relu"), "sigmoid": _e_elementwise("Sigmoid"),
+    "tanh": _e_elementwise("Tanh"), "exp": _e_elementwise("Exp"),
+    "log": _e_elementwise("Log"), "sqrt": _e_elementwise("Sqrt"),
+    "abs": _e_elementwise("Abs"), "erf": _e_elementwise("Erf"),
+    "gelu": _e_gelu,
+    "softmax": _e_softmax,
+    "layer_norm": _e_layer_norm,
+    "conv2d": _e_conv2d,
+    "batch_norm_infer": _e_bn_infer,
+    "max_pool2d": _e_max_pool,
+    "avg_pool2d": _e_avg_pool,
+    "adaptive_avg_pool2d": _e_adaptive_avg_pool,
+    "flatten": _e_flatten,
+    "reshape": _e_reshape,
+    "transpose": _e_transpose,
+    "unsqueeze": _e_unsqueeze,
+    "squeeze": _e_squeeze,
+    "concat": _e_concat,
+    "embedding": _e_embedding,
+    "cast": _e_cast,
+    "mean": _e_reduce("ReduceMean", axes_as_input=False),
+    "sum": _e_reduce("ReduceSum", axes_as_input=True),
+    "sdpa": _e_sdpa,
+    "getitem": _e_getitem,
+    "scale": _e_scale,
+}
+
+
+def export(layer, path, input_spec=None, opset_version=17, **configs):
+    """Export ``layer``'s forward as <path>.onnx (reference surface:
+    paddle.onnx.export).  The forward runs once in eval mode on example
+    inputs derived from ``input_spec`` (InputSpec or example Tensors);
+    every dispatched paddle op is emitted as ONNX node(s).  Returns the
+    written file path."""
+    import paddle_tpu as pt
+    from ..autograd import engine as _engine
+    from ..tensor import Tensor
+
+    if input_spec is None:
+        raise ValueError("onnx.export requires input_spec (InputSpec or "
+                         "example tensors)")
+    if int(opset_version) < 13:
+        raise NotImplementedError(
+            f"onnx export targets opset >= 13 (LayerNormalization et al.); "
+            f"got {opset_version}")
+
+    examples, graph_inputs = [], []
+    for i, spec in enumerate(input_spec):
+        if isinstance(spec, Tensor):
+            t, shape = spec, list(spec.shape)
+            dyn = ()
+        elif hasattr(spec, "shape"):           # static.InputSpec / ndarray
+            shape = list(spec.shape)
+            dyn = tuple(j for j, d in enumerate(shape)
+                        if d is None or (isinstance(d, int) and d < 0))
+            shape = [1 if j in dyn else int(d) for j, d in enumerate(shape)]
+            dtype = str(getattr(spec, "dtype", "float32"))
+            dtype = dtype.replace("paddle.", "").split(".")[-1]
+            if "int" in dtype:
+                t = pt.zeros(shape, dtype=dtype)
+            else:
+                t = pt.rand(shape).astype(dtype)
+        else:
+            raise TypeError(f"input_spec[{i}]: {spec!r}")
+        name = getattr(spec, "name", None) or f"x{i}"
+        examples.append(t)
+        graph_inputs.append((name, shape, str(t.dtype), dyn))
+
+    model = P.ModelProto(ir_version=8, producer_name="paddle_tpu",
+                         producer_version="0.4")
+    model.opset_import.add(domain="", version=int(opset_version))
+    g = model.graph
+    g.name = type(layer).__name__
+    ctx = _Ctx(g)
+
+    for (name, shape, dtype, dyn), t in zip(graph_inputs, examples):
+        g.input.append(_value_info(name, shape, dtype, dyn))
+        ctx.register(t._array, name)
+    for pname, pt_ in layer.state_dict().items():
+        ctx.register(pt_._array, pname)
+
+    captured = []
+
+    def obs(name, targs, consts, result):
+        outs = result if isinstance(result, tuple) else (result,)
+        captured.append((name, [t._array for t in targs], dict(consts or {}),
+                         [t._array for t in outs if isinstance(t, Tensor)]))
+
+    was_training = layer.training
+    layer.eval()
+    _engine.add_op_observer(obs)
+    try:
+        with pt.no_grad():
+            out = layer(*examples)
+    finally:
+        _engine.remove_op_observer(obs)
+        if was_training:
+            layer.train()
+    out_tensors = list(out) if isinstance(out, (tuple, list)) else [out]
+
+    # param/buffer initializers: only those the trace actually consumed
+    used = set()
+    for _, in_arrs, consts, _outs in captured:
+        used.update(id(a) for a in in_arrs)
+        used.update(id(v) for v in consts.values()
+                    if hasattr(v, "dtype") and hasattr(v, "shape"))
+    for pname, pt_ in layer.state_dict().items():
+        if id(pt_._array) in used:
+            g.initializer.append(_tensor_proto(pname, pt_._array))
+
+    for name, in_arrs, consts, out_arrs in captured:
+        emit = _EMIT.get(name)
+        if emit is None:
+            raise NotImplementedError(
+                f"onnx export: paddle op '{name}' has no ONNX emitter "
+                f"(supported: {sorted(_EMIT)})")
+        ins = [ctx.name_of(a) for a in in_arrs]
+        outs = []
+        for j, a in enumerate(out_arrs):
+            nm = ctx.fresh(f"{name}_out")
+            ctx.register(a, nm)
+            outs.append(nm)
+        emit(ctx, ins, consts, outs, in_arrs)
+
+    for i, t in enumerate(out_tensors):
+        nm = ctx.names.get(id(t._array))
+        if nm is None:
+            raise RuntimeError("output tensor not produced by traced ops")
+        final = f"output_{i}"
+        ctx.node("Identity", [nm], [final])
+        g.output.append(_value_info(final, list(t.shape), str(t.dtype)))
+
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    with open(out_path, "wb") as f:
+        f.write(model.SerializeToString())
+    return out_path
+
+
+def load(path):
+    """Parse a .onnx file into a ModelProto (our IR subset)."""
+    m = P.ModelProto()
+    with open(path, "rb") as f:
+        m.ParseFromString(f.read())
+    return m
+
+
+def run(path_or_model, inputs):
+    """Execute an exported model with the bundled reference evaluator
+    (numpy/jax; no onnxruntime needed).  ``inputs``: dict name->array or
+    list matching graph input order.  Returns list of output arrays."""
+    from .runtime import evaluate
+    model = load(path_or_model) if isinstance(path_or_model, str) \
+        else path_or_model
+    return evaluate(model, inputs)

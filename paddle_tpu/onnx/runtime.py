@@ -1,0 +1,229 @@
+"""Reference evaluator for the ONNX subset paddle_tpu.onnx emits.
+
+Executes a ModelProto node-by-node with numpy (jax.lax only for Conv /
+pooling windows).  This is an INDEPENDENT re-implementation of the op
+semantics from the ONNX operator spec — round-trip tests compare it
+against the live paddle layer, validating the serialized graph without
+needing onnxruntime in the image.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import onnx_subset_pb2 as P
+
+_NP_DT = {
+    P.TensorProto.FLOAT: np.float32, P.TensorProto.DOUBLE: np.float64,
+    P.TensorProto.FLOAT16: np.float16, P.TensorProto.INT32: np.int32,
+    P.TensorProto.INT64: np.int64, P.TensorProto.INT16: np.int16,
+    P.TensorProto.INT8: np.int8, P.TensorProto.UINT8: np.uint8,
+    P.TensorProto.BOOL: np.bool_,
+}
+
+
+def _tensor_value(t):
+    if t.data_type == P.TensorProto.BFLOAT16:
+        import jax.numpy as jnp
+        raw = np.frombuffer(t.raw_data, np.uint16).reshape(list(t.dims))
+        return np.asarray(jnp.asarray(raw).view(jnp.bfloat16),
+                          np.float32)
+    dt = _NP_DT[t.data_type]
+    if t.raw_data:
+        return np.frombuffer(t.raw_data, dt).reshape(list(t.dims)).copy()
+    if t.float_data:
+        return np.asarray(t.float_data, dt).reshape(list(t.dims))
+    if t.int64_data:
+        return np.asarray(t.int64_data, dt).reshape(list(t.dims))
+    if t.int32_data:
+        return np.asarray(t.int32_data, dt).reshape(list(t.dims))
+    return np.zeros(list(t.dims), dt)
+
+
+def _attrs(node):
+    out = {}
+    for a in node.attribute:
+        if a.type == P.AttributeProto.FLOAT:
+            out[a.name] = a.f
+        elif a.type == P.AttributeProto.INT:
+            out[a.name] = a.i
+        elif a.type == P.AttributeProto.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == P.AttributeProto.FLOATS:
+            out[a.name] = list(a.floats)
+        elif a.type == P.AttributeProto.INTS:
+            out[a.name] = list(a.ints)
+    return out
+
+
+def _softmax(x, axis):
+    m = x.max(axis=axis, keepdims=True)
+    e = np.exp(x - m)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _conv(x, w, b, at):
+    from jax import lax
+    import jax.numpy as jnp
+    ph, pw = at["pads"][0], at["pads"][1]
+    y = lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w),
+        window_strides=at.get("strides", [1, 1]),
+        padding=[(ph, at["pads"][2]), (pw, at["pads"][3])],
+        rhs_dilation=at.get("dilations", [1, 1]),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=at.get("group", 1))
+    y = np.asarray(y)
+    if b is not None:
+        y = y + b.reshape(1, -1, 1, 1)
+    return y
+
+
+def _pool(x, at, mode):
+    from jax import lax
+    import jax.numpy as jnp
+    kh, kw = at["kernel_shape"]
+    sh, sw = at.get("strides", at["kernel_shape"])
+    pads = at.get("pads", [0, 0, 0, 0])
+    pad = [(0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3])]
+    xa = jnp.asarray(x)
+    if mode == "max":
+        init, op = -jnp.inf, lax.max
+        y = lax.reduce_window(xa, init, op, (1, 1, kh, kw), (1, 1, sh, sw),
+                              pad)
+    else:
+        y = lax.reduce_window(xa, 0.0, lax.add, (1, 1, kh, kw),
+                              (1, 1, sh, sw), pad)
+        if at.get("count_include_pad", 0):
+            y = y / (kh * kw)
+        else:
+            ones = jnp.ones_like(xa)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, (1, 1, kh, kw),
+                                    (1, 1, sh, sw), pad)
+            y = y / cnt
+    return np.asarray(y)
+
+
+def evaluate(model, inputs):
+    g = model.graph
+    env = {}
+    for init in g.initializer:
+        env[init.name] = _tensor_value(init)
+    graph_ins = [vi.name for vi in g.input]
+    if isinstance(inputs, dict):
+        env.update({k: np.asarray(v) for k, v in inputs.items()})
+    else:
+        for name, v in zip(graph_ins, inputs):
+            env[name] = np.asarray(v)
+
+    for node in g.node:
+        at = _attrs(node)
+        ins = [env[n] if n else None for n in node.input]
+        op = node.op_type
+        if op == "MatMul":
+            r = ins[0] @ ins[1]
+        elif op == "Add":
+            r = ins[0] + ins[1]
+        elif op == "Sub":
+            r = ins[0] - ins[1]
+        elif op == "Mul":
+            r = ins[0] * ins[1]
+        elif op == "Div":
+            r = ins[0] / ins[1]
+        elif op == "Pow":
+            r = ins[0] ** ins[1]
+        elif op == "Max":
+            r = np.maximum(ins[0], ins[1])
+        elif op == "Min":
+            r = np.minimum(ins[0], ins[1])
+        elif op == "Relu":
+            r = np.maximum(ins[0], 0)
+        elif op == "Sigmoid":
+            r = 1.0 / (1.0 + np.exp(-ins[0]))
+        elif op == "Tanh":
+            r = np.tanh(ins[0])
+        elif op == "Exp":
+            r = np.exp(ins[0])
+        elif op == "Log":
+            r = np.log(ins[0])
+        elif op == "Sqrt":
+            r = np.sqrt(ins[0])
+        elif op == "Abs":
+            r = np.abs(ins[0])
+        elif op == "Erf":
+            import math
+            r = np.vectorize(math.erf)(
+                ins[0].astype(np.float64)).astype(ins[0].dtype)
+        elif op == "Softmax":
+            r = _softmax(ins[0], int(at.get("axis", -1)))
+        elif op == "LayerNormalization":
+            ax = int(at.get("axis", -1))
+            eps = at.get("epsilon", 1e-5)
+            axes = tuple(range(ax % ins[0].ndim, ins[0].ndim))
+            mu = ins[0].mean(axis=axes, keepdims=True)
+            var = ins[0].var(axis=axes, keepdims=True)
+            r = (ins[0] - mu) / np.sqrt(var + eps)
+            r = r * ins[1] + (ins[2] if len(ins) > 2 else 0.0)
+        elif op == "BatchNormalization":
+            x, w, b, mean, var = ins[:5]
+            eps = at.get("epsilon", 1e-5)
+            shape = [1, -1] + [1] * (x.ndim - 2)
+            r = ((x - mean.reshape(shape))
+                 / np.sqrt(var.reshape(shape) + eps)
+                 * w.reshape(shape) + b.reshape(shape))
+        elif op == "Conv":
+            r = _conv(ins[0], ins[1], ins[2] if len(ins) > 2 else None, at)
+        elif op == "MaxPool":
+            r = _pool(ins[0], at, "max")
+        elif op == "AveragePool":
+            r = _pool(ins[0], at, "avg")
+        elif op == "GlobalAveragePool":
+            r = ins[0].mean(axis=(2, 3), keepdims=True)
+        elif op == "Flatten":
+            ax = int(at.get("axis", 1))
+            r = ins[0].reshape(int(np.prod(ins[0].shape[:ax]) or 1), -1)
+        elif op == "Reshape":
+            shape = [int(s) for s in ins[1]]
+            shape = [ins[0].shape[i] if s == 0 else s
+                     for i, s in enumerate(shape)]
+            r = ins[0].reshape(shape)
+        elif op == "Transpose":
+            r = ins[0].transpose(at["perm"])
+        elif op == "Unsqueeze":
+            r = ins[0]
+            for ax in sorted(int(a) for a in ins[1]):
+                r = np.expand_dims(r, ax)
+        elif op == "Squeeze":
+            if len(ins) > 1 and ins[1] is not None:
+                r = np.squeeze(ins[0], axis=tuple(int(a) for a in ins[1]))
+            else:
+                r = np.squeeze(ins[0])
+        elif op == "Concat":
+            r = np.concatenate(ins, axis=int(at["axis"]))
+        elif op == "Gather":
+            r = np.take(ins[0], ins[1].astype(np.int64),
+                        axis=int(at.get("axis", 0)))
+        elif op == "Slice":
+            starts, ends = ins[1], ins[2]
+            axes = ins[3] if len(ins) > 3 else np.arange(len(starts))
+            steps = ins[4] if len(ins) > 4 else np.ones(len(starts),
+                                                        np.int64)
+            sl = [slice(None)] * ins[0].ndim
+            for s, e, a, st in zip(starts, ends, axes, steps):
+                sl[int(a)] = slice(int(s), int(e), int(st))
+            r = ins[0][tuple(sl)]
+        elif op == "ReduceMean":
+            axes = at.get("axes")
+            r = ins[0].mean(axis=tuple(axes) if axes else None,
+                            keepdims=bool(at.get("keepdims", 1)))
+        elif op == "ReduceSum":
+            axes = tuple(int(a) for a in ins[1]) if len(ins) > 1 else None
+            r = ins[0].sum(axis=axes, keepdims=bool(at.get("keepdims", 1)))
+        elif op == "Cast":
+            r = ins[0].astype(_NP_DT[int(at["to"])])
+        elif op == "Identity":
+            r = ins[0]
+        else:
+            raise NotImplementedError(f"onnx runtime: op {op}")
+        env[node.output[0]] = r
+
+    return [env[vi.name] for vi in g.output]

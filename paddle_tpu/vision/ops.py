@@ -18,7 +18,7 @@ from ..tensor_api import _t
 from ..ops import dispatch as ops
 
 __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "box_iou",
-           "deform_conv2d", "DeformConv2D"]
+           "deform_conv2d", "DeformConv2D", "yolo_box", "yolo_loss"]
 
 
 # ------------------------------------------------------------------ box iou
@@ -529,6 +529,162 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
     boxes = boxes * keep[..., None]
     scores = scores * keep[..., None]
     return Tensor._from_array(boxes), Tensor._from_array(scores)
+
+
+def _bce(p, t, eps=1e-9):
+    """Elementwise binary cross entropy on probabilities."""
+    p = jnp.clip(p, eps, 1.0 - eps)
+    return -(t * jnp.log(p) + (1.0 - t) * jnp.log(1.0 - p))
+
+
+def _yolo_loss_impl(x, gt_box, gt_label, gt_score, anchors, anchor_mask,
+                    class_num, ignore_thresh, downsample_ratio,
+                    use_label_smooth, scale_x_y):
+    """YOLOv3 training loss (reference: vision/ops.py yolo_loss /
+    fluid yolov3_loss).  Fully vectorized, static shapes — the
+    reference's per-gt CPU/CUDA loops become masked scatters:
+
+    * each gt picks its best anchor by shape-only IoU over ALL anchors;
+      gts whose best anchor belongs to this head's anchor_mask become
+      positives at their center cell (last gt wins a contested cell,
+      matching the reference's overwrite-in-order)
+    * x/y use binary cross entropy on the sigmoid offsets, w/h use L1 on
+      the raw log-scale predictions, both weighted by (2 - gw*gh) and
+      the gt's score (mixup weight)
+    * objectness: BCE to gt_score at positives, BCE to 0 at negatives,
+      except cells whose DECODED prediction overlaps any gt above
+      ignore_thresh (those are ignored, per the paper)
+    * classification: per-class BCE with optional label smoothing
+      (pos 1-1/C, neg 1/C)
+
+    Returns per-sample loss [N].
+    """
+    N, C, H, W = x.shape
+    A = len(anchor_mask)
+    na_all = len(anchors) // 2
+    all_an = jnp.asarray(anchors, jnp.float32).reshape(na_all, 2)
+    mask_idx = jnp.asarray(anchor_mask, jnp.int32)
+    mask_an = all_an[mask_idx]                       # [A, 2] (w, h) px
+    input_w = float(W * downsample_ratio)
+    input_h = float(H * downsample_ratio)
+    s = float(scale_x_y)
+
+    pred = x.reshape(N, A, 5 + class_num, H, W).transpose(0, 1, 3, 4, 2)
+    pred = pred.reshape(N, A * H * W, 5 + class_num).astype(jnp.float32)
+    P = A * H * W
+    px_raw, py_raw = pred[..., 0], pred[..., 1]
+    pw_raw, ph_raw = pred[..., 2], pred[..., 3]
+    pobj = jax.nn.sigmoid(pred[..., 4])
+    pcls = jax.nn.sigmoid(pred[..., 5:])             # [N, P, cls]
+    sx = jax.nn.sigmoid(px_raw) * s - (s - 1.0) / 2.0
+    sy = jax.nn.sigmoid(py_raw) * s - (s - 1.0) / 2.0
+
+    # decoded prediction boxes (normalized cx cy w h) for the ignore mask
+    gx = jnp.tile(jnp.arange(W, dtype=jnp.float32)[None, :], (H, 1))
+    gy = jnp.tile(jnp.arange(H, dtype=jnp.float32)[:, None], (1, W))
+    gx = jnp.tile(gx.reshape(1, -1), (A, 1)).reshape(P)
+    gy = jnp.tile(gy.reshape(1, -1), (A, 1)).reshape(P)
+    aw = jnp.repeat(mask_an[:, 0], H * W)            # [P]
+    ah = jnp.repeat(mask_an[:, 1], H * W)
+    pbx = (gx[None] + sx) / W
+    pby = (gy[None] + sy) / H
+    pbw = jnp.exp(jnp.clip(pw_raw, -20.0, 20.0)) * aw[None] / input_w
+    pbh = jnp.exp(jnp.clip(ph_raw, -20.0, 20.0)) * ah[None] / input_h
+
+    gtb = gt_box.astype(jnp.float32)                  # [N, B, 4] cx cy w h
+    gw, gh = gtb[..., 2], gtb[..., 3]
+    gvalid = (gw > 0) & (gh > 0)                      # padding gts are 0
+
+    # IoU of every decoded pred vs every gt (cxcywh -> corners)
+    def _corners(cx, cy, w, h):
+        return cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2
+
+    px1, py1, px2, py2 = _corners(pbx, pby, pbw, pbh)       # [N, P]
+    qx1, qy1, qx2, qy2 = _corners(gtb[..., 0], gtb[..., 1], gw, gh)
+    ix1 = jnp.maximum(px1[:, :, None], qx1[:, None, :])     # [N, P, B]
+    iy1 = jnp.maximum(py1[:, :, None], qy1[:, None, :])
+    ix2 = jnp.minimum(px2[:, :, None], qx2[:, None, :])
+    iy2 = jnp.minimum(py2[:, :, None], qy2[:, None, :])
+    inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
+    union = (pbw * pbh)[:, :, None] + (gw * gh)[:, None, :] - inter
+    iou = jnp.where(gvalid[:, None, :], inter / jnp.maximum(union, 1e-10),
+                    0.0)
+    ignore = jnp.max(iou, axis=2) > ignore_thresh            # [N, P]
+
+    # best anchor per gt: shape-only IoU against ALL anchors (px units)
+    gwp, ghp = gw * input_w, gh * input_h                    # [N, B]
+    inter_a = (jnp.minimum(gwp[..., None], all_an[None, None, :, 0])
+               * jnp.minimum(ghp[..., None], all_an[None, None, :, 1]))
+    union_a = (gwp * ghp)[..., None] \
+        + (all_an[:, 0] * all_an[:, 1])[None, None, :] - inter_a
+    best = jnp.argmax(inter_a / jnp.maximum(union_a, 1e-10), axis=2)
+    in_mask = best[..., None] == mask_idx[None, None, :]     # [N, B, A]
+    k = jnp.argmax(in_mask, axis=2)                          # [N, B]
+    responsible = gvalid & jnp.any(in_mask, axis=2)
+
+    gi = jnp.clip((gtb[..., 0] * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gtb[..., 1] * H).astype(jnp.int32), 0, H - 1)
+    flat = (k * H + gj) * W + gi                             # [N, B] in [0,P)
+
+    # scatter gt -> grid; a contested cell goes to the LAST responsible gt
+    B = gtb.shape[1]
+    assign = (jax.nn.one_hot(flat, P, dtype=jnp.float32)
+              * responsible[..., None].astype(jnp.float32))  # [N, B, P]
+    pos = jnp.any(assign > 0, axis=1)                        # [N, P]
+    order = jnp.arange(1, B + 1, dtype=jnp.float32)[None, :, None]
+    owner = jnp.argmax(assign * order, axis=1)               # [N, P]
+
+    def _pick(v):                                            # [N, B] -> [N, P]
+        return jnp.take_along_axis(v, owner, axis=1)
+
+    tx = _pick(gtb[..., 0] * W - gi.astype(jnp.float32))
+    ty = _pick(gtb[..., 1] * H - gj.astype(jnp.float32))
+    tw = _pick(jnp.log(jnp.maximum(gwp, 1e-10))) - jnp.log(aw)[None]
+    th = _pick(jnp.log(jnp.maximum(ghp, 1e-10))) - jnp.log(ah)[None]
+    tscale = _pick(2.0 - gw * gh)
+    score = (jnp.ones_like(gw) if gt_score is None
+             else gt_score.astype(jnp.float32))
+    tobj = _pick(score)
+    tlabel = jnp.take_along_axis(gt_label.astype(jnp.int32), owner, axis=1)
+
+    posf = pos.astype(jnp.float32)
+    w_box = posf * tscale * tobj
+    loss_xy = (_bce(sx, tx) + _bce(sy, ty)) * w_box
+    loss_wh = (jnp.abs(pw_raw - tw) + jnp.abs(ph_raw - th)) * 0.5 * w_box
+    noobj = (1.0 - posf) * (1.0 - ignore.astype(jnp.float32))
+    loss_obj = _bce(pobj, tobj) * posf + _bce(pobj, 0.0) * noobj
+    if use_label_smooth and class_num > 1:
+        t_pos, t_neg = 1.0 - 1.0 / class_num, 1.0 / class_num
+    else:
+        t_pos, t_neg = 1.0, 0.0
+    onehot = jax.nn.one_hot(tlabel, class_num, dtype=jnp.float32)
+    tcls = onehot * t_pos + (1.0 - onehot) * t_neg
+    loss_cls = jnp.sum(_bce(pcls, tcls), axis=2) * posf * tobj
+
+    return jnp.sum(loss_xy + loss_wh + loss_obj + loss_cls, axis=1)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (reference surface: paddle.vision.ops.yolo_loss).
+    Differentiable w.r.t. ``x``; gt inputs carry no gradient."""
+    args = [_t(x), _t(gt_box), _t(gt_label)]
+    if gt_score is not None:
+        args.append(_t(gt_score))
+
+    def _impl(xa, gba, gla, *rest):
+        gsa = rest[0] if rest else None
+        return _yolo_loss_impl(
+            xa, gba, gla, gsa, anchors=tuple(anchors),
+            anchor_mask=tuple(anchor_mask), class_num=int(class_num),
+            ignore_thresh=float(ignore_thresh),
+            downsample_ratio=int(downsample_ratio),
+            use_label_smooth=bool(use_label_smooth),
+            scale_x_y=float(scale_x_y))
+
+    from ..autograd import engine as _engine
+    return _engine.apply("yolo_loss", _impl, args)
 
 
 def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,

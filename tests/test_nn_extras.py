@@ -167,3 +167,17 @@ def test_simple_rnn_cell_and_birnn():
     loss.backward()
     assert cell_f.weight_ih.grad is not None
     assert cell_b.weight_hh.grad is not None
+
+
+def test_set_state_dict_accepts_torch_tensors():
+    # interop path: HF converters hand over torch CPU tensors; the batched
+    # cast in Layer.set_state_dict must coerce non-jax array-likes
+    import numpy as np
+    import torch
+    import paddle_tpu as pt
+    lin = pt.nn.Linear(3, 2)
+    w = torch.arange(6, dtype=torch.float32).reshape(3, 2)
+    b = torch.zeros(2)
+    missing, unexpected = lin.set_state_dict({"weight": w, "bias": b})
+    assert not missing and not unexpected
+    np.testing.assert_allclose(lin.weight.numpy(), w.numpy())

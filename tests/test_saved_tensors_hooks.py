@@ -88,15 +88,16 @@ class TestSavedTensorsHooks:
         y.backward()
         np.testing.assert_allclose(x.grad.numpy(), g1)
 
-    def test_set_state_dict_accepts_torch_tensors(self):
-        # interop path: HF converters hand over torch CPU tensors
-        import torch
-        lin = pt.nn.Linear(3, 2)
-        w = torch.arange(6, dtype=torch.float32).reshape(3, 2)
-        b = torch.zeros(2)
-        missing, unexpected = lin.set_state_dict({"weight": w, "bias": b})
-        assert not missing and not unexpected
-        np.testing.assert_allclose(lin.weight.numpy(), w.numpy())
+    def test_create_graph_through_int_aux_output_op(self):
+        # float0-cotangent fallback must lazily rebuild the hooked node's
+        # vjp (regression: vjp_fn was None on this path)
+        x = pt.to_tensor(np.array([[3.0, 1.0, 2.0]], np.float32))
+        x.stop_gradient = False
+        with saved_tensors_hooks(lambda t: t, lambda t: t):
+            vals, idx = pt.topk(x, k=2)
+        (g,) = pt.grad([vals.sum()], [x], create_graph=True)
+        expect = np.array([[1.0, 0.0, 1.0]], np.float32)
+        np.testing.assert_allclose(g.numpy(), expect)
 
     def test_double_backward_through_hooked_op(self):
         x = pt.to_tensor(np.array([0.5, -0.3], np.float32))

@@ -1,0 +1,117 @@
+"""paddle.vision.ops.yolo_loss — YOLOv3 training loss.
+
+Semantic checks (the reference kernel is CPU/CUDA loops; ours is masked
+vector math, vision/ops.py _yolo_loss_impl): a head constructed to
+predict a gt box exactly should incur ~zero positive-sample loss; the
+loss must be differentiable w.r.t. x; ignored (high-IoU) cells must not
+pay noobj loss; and a tiny head must overfit one target.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.vision import ops as vops
+
+ANCHORS = [10, 13, 16, 30, 33, 23]     # one 3-anchor level
+MASK = [0, 1, 2]
+CLS = 4
+H = W = 8
+DOWN = 32                               # input 256x256
+
+
+def _head(seed=0, scale=0.01):
+    rng = np.random.RandomState(seed)
+    return rng.randn(2, len(MASK) * (5 + CLS), H, W).astype(np.float32) * scale
+
+
+def _gt(cx, cy, w, h, label, batch=2, pad_to=3):
+    gt_box = np.zeros((batch, pad_to, 4), np.float32)
+    gt_label = np.zeros((batch, pad_to), np.int64)
+    gt_box[:, 0] = [cx, cy, w, h]
+    gt_label[:, 0] = label
+    return gt_box, gt_label
+
+
+def _loss(x, gt_box, gt_label, **kw):
+    t = pt.to_tensor(x)
+    t.stop_gradient = False
+    out = vops.yolo_loss(t, pt.to_tensor(gt_box), pt.to_tensor(gt_label),
+                         anchors=ANCHORS, anchor_mask=MASK, class_num=CLS,
+                         ignore_thresh=0.7, downsample_ratio=DOWN, **kw)
+    return t, out
+
+
+class TestYoloLoss:
+    def test_shape_and_grad_flow(self):
+        gt_box, gt_label = _gt(0.5, 0.5, 0.2, 0.3, 2)
+        t, loss = _loss(_head(), gt_box, gt_label)
+        assert loss.shape == [2]
+        loss.sum().backward()
+        g = t.grad.numpy()
+        assert list(g.shape) == list(t.shape) and np.isfinite(g).all()
+        assert np.abs(g).max() > 0
+
+    def test_perfect_prediction_near_zero_positive_loss(self):
+        # gt of exactly anchor-1's shape centered in cell (4,4); build x so
+        # the responsible cell predicts it exactly and all sigmoids saturate
+        aw, ah = ANCHORS[2], ANCHORS[3]          # anchor index 1 of mask
+        gw, gh = aw / (W * DOWN), ah / (H * DOWN)
+        gt_box, gt_label = _gt(4.5 / W, 4.5 / H, gw, gh, 1)
+        x = np.zeros((2, len(MASK) * (5 + CLS), H, W), np.float32)
+        x[:, :, :, :] = -12.0                    # sigmoid ~ 0 everywhere
+        base = 1 * (5 + CLS)                     # anchor slot 1
+        x[:, base + 0, 4, 4] = 0.0               # sigmoid 0.5 = offset .5
+        x[:, base + 1, 4, 4] = 0.0
+        x[:, base + 2, 4, 4] = 0.0               # tw = log(gw*in/aw) = 0
+        x[:, base + 3, 4, 4] = 0.0
+        x[:, base + 4, 4, 4] = 12.0              # objectness ~ 1
+        x[:, base + 5 + 1, 4, 4] = 12.0          # class 1 ~ 1
+        _, loss = _loss(x, gt_box, gt_label, use_label_smooth=False)
+        v = loss.numpy()
+        # x/y use BCE against the 0.5-offset target, whose minimum is the
+        # target's entropy (2*H(0.5) = 2*ln2), scaled by (2 - gw*gh); all
+        # other components must be ~0 at a perfect prediction
+        floor = 2.0 * np.log(2.0) * (2.0 - gw * gh)
+        assert (np.abs(v - floor) < 0.05).all(), (v, floor)
+
+    def test_wrong_prediction_losses_more(self):
+        gt_box, gt_label = _gt(0.55, 0.55, 0.15, 0.2, 3)
+        _, l_small = _loss(_head(0, 0.01), gt_box, gt_label)
+        _, l_big = _loss(_head(0, 3.0), gt_box, gt_label)
+        assert l_big.numpy().sum() > l_small.numpy().sum()
+
+    def test_no_valid_gt_means_only_noobj(self):
+        # all-zero gt boxes are padding: loss is pure noobj objectness
+        gt_box = np.zeros((2, 3, 4), np.float32)
+        gt_label = np.zeros((2, 3), np.int64)
+        x = np.full((2, len(MASK) * (5 + CLS), H, W), -12.0, np.float32)
+        _, loss = _loss(x, gt_box, gt_label)
+        assert (loss.numpy() < 0.01).all()
+
+    def test_gt_score_scales_positive_loss(self):
+        gt_box, gt_label = _gt(0.5, 0.5, 0.2, 0.3, 2)
+        x = _head(1, 0.5)
+        _, l_full = _loss(x, gt_box, gt_label,
+                          gt_score=np.ones((2, 3), np.float32))
+        _, l_half = _loss(x, gt_box, gt_label,
+                          gt_score=np.full((2, 3), 0.5, np.float32))
+        assert l_half.numpy().sum() < l_full.numpy().sum()
+
+    def test_overfit_one_target(self):
+        gt_box, gt_label = _gt(0.4, 0.6, 0.25, 0.25, 0)
+        t = pt.to_tensor(_head(3, 0.1))
+        t.stop_gradient = False
+        opt = pt.optimizer.Adam(learning_rate=0.05, parameters=[t])
+        first = None
+        for i in range(60):
+            loss = vops.yolo_loss(
+                t, pt.to_tensor(gt_box), pt.to_tensor(gt_label),
+                anchors=ANCHORS, anchor_mask=MASK, class_num=CLS,
+                ignore_thresh=0.7, downsample_ratio=DOWN).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(loss)
+        # converges to the BCE/label-smooth entropy floor (~0.12x start)
+        assert float(loss) < first * 0.25, (first, float(loss))
