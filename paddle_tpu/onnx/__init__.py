@@ -117,6 +117,31 @@ def _pair(v):
     return [v, v] if isinstance(v, int) else list(v)
 
 
+def _pad_pair(v, what):
+    """Symmetric (ph, pw) padding or a clean refusal — Paddle also allows
+    4-element and 'SAME'/'VALID' string paddings, which need dedicated
+    emitter handling, not a cryptic unpack error."""
+    if isinstance(v, str):
+        raise NotImplementedError(
+            f"onnx export: {what} string padding {v!r}; use explicit ints")
+    p = _pair(v)
+    if len(p) != 2 or not all(isinstance(x, int) for x in p):
+        raise NotImplementedError(
+            f"onnx export: {what} padding {v!r}; only symmetric "
+            "int/(ph, pw) padding is supported")
+    return p
+
+
+def _reshape_target(shape, in_arr):
+    """ONNX Reshape target; the traced batch dim becomes 0 ('copy from
+    input') so batch-dynamic graphs (dim_param inputs) run at any batch."""
+    shape = [int(s) for s in shape]
+    in_shape = _np(in_arr).shape
+    if shape and in_shape and shape[0] == in_shape[0]:
+        shape[0] = 0
+    return shape
+
+
 # ------------------------------------------------------------- op emitters
 # each: emit(ctx, ins, consts, outs, arrs) where ins/outs are value names
 # and arrs the concrete input arrays (for shape-dependent decompositions)
@@ -149,17 +174,37 @@ def _e_softmax(ctx, ins, consts, outs, arrs):
 
 
 def _e_gelu(ctx, ins, consts, outs, arrs):
-    # decompose to Erf (opset>=9) so files load everywhere:
-    # gelu(x) = 0.5 * x * (1 + erf(x / sqrt(2)))
+    """Decomposed gelu; honors the captured ``approximate`` flag (GPT
+    uses tanh-gelu — silently emitting erf-gelu would change the model
+    by up to ~5e-4 per activation)."""
     x = ins[0]
     dt = _np(arrs[0]).dtype
-    inv = ctx.fresh("gelu_scaled")
-    ctx.node("Mul", [x, ctx.name_of(np.asarray(1.0 / np.sqrt(2.0), dt))],
-             [inv])
-    erf = ctx.fresh("gelu_erf")
-    ctx.node("Erf", [inv], [erf])
-    one = ctx.fresh("gelu_1p")
-    ctx.node("Add", [erf, ctx.name_of(np.asarray(1.0, dt))], [one])
+    if consts.get("approximate"):
+        # 0.5*x*(1+tanh(sqrt(2/pi)*(x + 0.044715*x^3)))
+        cb = ctx.fresh("gelu_x3")
+        ctx.node("Mul", [x, x], [cb + "_sq"])
+        ctx.node("Mul", [cb + "_sq", x], [cb])
+        scaled = ctx.fresh("gelu_inner")
+        ctx.node("Mul", [cb, ctx.name_of(np.asarray(0.044715, dt))],
+                 [scaled + "_c"])
+        ctx.node("Add", [x, scaled + "_c"], [scaled])
+        arg = ctx.fresh("gelu_arg")
+        ctx.node("Mul", [scaled,
+                         ctx.name_of(np.asarray(np.sqrt(2.0 / np.pi), dt))],
+                 [arg])
+        th = ctx.fresh("gelu_tanh")
+        ctx.node("Tanh", [arg], [th])
+        one = ctx.fresh("gelu_1p")
+        ctx.node("Add", [th, ctx.name_of(np.asarray(1.0, dt))], [one])
+    else:
+        # 0.5 * x * (1 + erf(x / sqrt(2)))
+        inv = ctx.fresh("gelu_scaled")
+        ctx.node("Mul", [x, ctx.name_of(np.asarray(1.0 / np.sqrt(2.0), dt))],
+                 [inv])
+        erf = ctx.fresh("gelu_erf")
+        ctx.node("Erf", [inv], [erf])
+        one = ctx.fresh("gelu_1p")
+        ctx.node("Add", [erf, ctx.name_of(np.asarray(1.0, dt))], [one])
     half = ctx.fresh("gelu_half")
     ctx.node("Mul", [x, one], [half])
     ctx.node("Mul", [half, ctx.name_of(np.asarray(0.5, dt))], outs)
@@ -174,7 +219,7 @@ def _e_layer_norm(ctx, ins, consts, outs, arrs):
 def _e_conv2d(ctx, ins, consts, outs, arrs):
     if consts.get("data_format", "NCHW") != "NCHW":
         raise NotImplementedError("onnx export: conv2d NHWC")
-    ph, pw = _pair(consts.get("padding", 0))
+    ph, pw = _pad_pair(consts.get("padding", 0), "conv2d")
     ctx.node("Conv", ins, outs,
              strides=_pair(consts.get("stride", 1)),
              pads=[ph, pw, ph, pw],
@@ -188,7 +233,7 @@ def _e_bn_infer(ctx, ins, consts, outs, arrs):
 
 
 def _e_max_pool(ctx, ins, consts, outs, arrs):
-    ph, pw = _pair(consts.get("padding", 0))
+    ph, pw = _pad_pair(consts.get("padding", 0), "max_pool2d")
     ctx.node("MaxPool", ins, outs,
              kernel_shape=_pair(consts["kernel_size"]),
              strides=_pair(consts.get("stride") or consts["kernel_size"]),
@@ -197,7 +242,7 @@ def _e_max_pool(ctx, ins, consts, outs, arrs):
 
 
 def _e_avg_pool(ctx, ins, consts, outs, arrs):
-    ph, pw = _pair(consts.get("padding", 0))
+    ph, pw = _pad_pair(consts.get("padding", 0), "avg_pool2d")
     ctx.node("AveragePool", ins, outs,
              kernel_shape=_pair(consts["kernel_size"]),
              strides=_pair(consts.get("stride") or consts["kernel_size"]),
@@ -225,13 +270,15 @@ def _e_flatten(ctx, ins, consts, outs, arrs):
         shape = list(_np(arrs[0]).shape)
         merged = shape[:start] + [-1] + shape[stop + 1:]
         sh = ctx.add_init(ctx.fresh("shape"),
-                          np.asarray(merged, np.int64))
+                          np.asarray(_reshape_target(merged, arrs[0]),
+                                     np.int64))
         ctx.node("Reshape", [ins[0], sh], outs)
 
 
 def _e_reshape(ctx, ins, consts, outs, arrs):
     sh = ctx.add_init(ctx.fresh("shape"),
-                      np.asarray(list(consts["shape"]), np.int64))
+                      np.asarray(_reshape_target(consts["shape"], arrs[0]),
+                                 np.int64))
     ctx.node("Reshape", [ins[0], sh], outs)
 
 
@@ -299,17 +346,35 @@ def _e_reduce(onnx_op, axes_as_input):
 def _e_sdpa(ctx, ins, consts, outs, arrs):
     """Scaled dot-product attention decomposition ([B, L, H, D] layout)."""
     q, k, v = arrs[:3]
-    if q.shape[2] != k.shape[2]:
-        raise NotImplementedError("onnx export: GQA sdpa (H != H_kv)")
     B, L, H, D = q.shape
+    Hkv = k.shape[2]
     dt = _np(q).dtype
     scale = consts.get("scale") or 1.0 / float(np.sqrt(D))
     qt = ctx.fresh("sdpa_q")   # [B, H, L, D]
     ctx.node("Transpose", [ins[0]], [qt], perm=[0, 2, 1, 3])
-    kt = ctx.fresh("sdpa_kT")  # [B, H, D, L]
+    kt = ctx.fresh("sdpa_kT")  # [B, Hkv, D, L]
     ctx.node("Transpose", [ins[1]], [kt], perm=[0, 2, 3, 1])
-    vt = ctx.fresh("sdpa_v")
+    vt = ctx.fresh("sdpa_v")   # [B, Hkv, L, D]
     ctx.node("Transpose", [ins[2]], [vt], perm=[0, 2, 1, 3])
+    if Hkv != H:               # GQA: repeat each kv head H/Hkv times
+        G = H // Hkv
+        ax2 = ctx.add_init(ctx.fresh("axes"), np.asarray([2], np.int64))
+        reps = ctx.add_init(ctx.fresh("reps"),
+                            np.asarray([1, 1, G, 1, 1], np.int64))
+        for nm, tail in ((kt, (D, L)), (vt, (L, D))):
+            u = ctx.fresh("gqa_u")
+            ctx.node("Unsqueeze", [nm, ax2], [u])
+            tl = ctx.fresh("gqa_tile")
+            ctx.node("Tile", [u, reps], [tl])
+            sh = ctx.add_init(ctx.fresh("shape"),
+                              np.asarray([0, H, tail[0], tail[1]],
+                                         np.int64))
+            rs = ctx.fresh("gqa_rep")
+            ctx.node("Reshape", [tl, sh], [rs])
+            if nm is kt:
+                kt = rs
+            else:
+                vt = rs
     logits = ctx.fresh("sdpa_logits")
     ctx.node("MatMul", [qt, kt], [logits])
     scaled = ctx.fresh("sdpa_scaled")
@@ -335,12 +400,16 @@ def _e_getitem(ctx, ins, consts, outs, arrs):
     index = consts["index"]
     if not isinstance(index, tuple):
         index = (index,)
-    nd = _np(arrs[0]).ndim
     starts, ends, axes, steps, squeeze_axes = [], [], [], [], []
     for ax, it in enumerate(index):
         if isinstance(it, slice):
             if it.start is None and it.stop is None and it.step is None:
                 continue
+            if (it.step or 1) < 0:
+                raise NotImplementedError(
+                    "onnx export: negative-step slice (reversal); ONNX "
+                    "Slice needs start=-1/end=INT_MIN forms not emitted "
+                    "here")
             starts.append(it.start or 0)
             ends.append(it.stop if it.stop is not None else 2**31 - 1)
             axes.append(ax)
@@ -373,7 +442,6 @@ def _e_getitem(ctx, ins, consts, outs, arrs):
         ctx.g.node[-1].output[0] = outs[0]
     else:
         ctx.node("Identity", [cur], outs)
-    _ = nd
 
 
 def _e_scale(ctx, ins, consts, outs, arrs):
@@ -389,8 +457,137 @@ def _e_scale(ctx, ins, consts, outs, arrs):
         ctx.node("Add", [cur, ctx.name_of(np.asarray(b, dt))], outs)
 
 
+def _e_unbind(ctx, ins, consts, outs, arrs):
+    ax = int(consts.get("axis", 0))
+    parts = [ctx.fresh("unbind_part") for _ in outs]
+    ctx.node("Split", ins, parts, axis=ax)  # equal split = output count (opset 13+)
+    sq = ctx.add_init(ctx.fresh("axes"), np.asarray([ax], np.int64))
+    for part, out in zip(parts, outs):
+        ctx.node("Squeeze", [part, sq], [out])
+
+
+def _e_rms_norm(ctx, ins, consts, outs, arrs):
+    # x * w / sqrt(mean(x^2, -1) + eps) — ONNX has no RMSNorm core op
+    x, w = ins[:2]
+    dt = _np(arrs[0]).dtype
+    sq = ctx.fresh("rms_sq")
+    ctx.node("Mul", [x, x], [sq])
+    ms = ctx.fresh("rms_ms")
+    ctx.node("ReduceMean", [sq], [ms], axes=[-1], keepdims=1)
+    stable = ctx.fresh("rms_eps")
+    ctx.node("Add", [ms, ctx.name_of(
+        np.asarray(consts.get("eps", 1e-6), dt))], [stable])
+    root = ctx.fresh("rms_sqrt")
+    ctx.node("Sqrt", [stable], [root])
+    normed = ctx.fresh("rms_normed")
+    ctx.node("Div", [x, root], [normed])
+    ctx.node("Mul", [normed, w], outs)
+
+
+def _e_silu(ctx, ins, consts, outs, arrs):
+    sig = ctx.fresh("silu_sig")
+    ctx.node("Sigmoid", ins, [sig])
+    ctx.node("Mul", [ins[0], sig], outs)
+
+
+def _e_stack(ctx, ins, consts, outs, arrs):
+    ax = int(consts.get("axis", 0))
+    axes = ctx.add_init(ctx.fresh("axes"), np.asarray([ax], np.int64))
+    unsq = []
+    for i in ins:
+        u = ctx.fresh("stack_u")
+        ctx.node("Unsqueeze", [i, axes], [u])
+        unsq.append(u)
+    ctx.node("Concat", unsq, outs, axis=ax)
+
+
+def _e_split(ctx, ins, consts, outs, arrs):
+    ax = int(consts.get("axis", 0))
+    sections = consts.get("num_or_sections")
+    if isinstance(sections, (list, tuple)):
+        sp = ctx.add_init(ctx.fresh("split"),
+                          np.asarray(list(sections), np.int64))
+        ctx.node("Split", [ins[0], sp], outs, axis=ax)
+    else:
+        ctx.node("Split", ins, outs, axis=ax)
+
+
+def _e_rope(ctx, ins, consts, outs, arrs):
+    """Rotary embedding: static cos/sin tables become initializers; the
+    interleaved rotation decomposes to Slice/Mul/Sub/Add/Concat/Reshape
+    (text/llama.py _rope)."""
+    if len(ins) != 2:
+        raise NotImplementedError(
+            "onnx export: rope with a kv-cache position input (decode "
+            "graphs); export the prefill/training forward instead")
+    q = _np(arrs[0])
+    b, s, h, d = q.shape
+    dt = q.dtype
+    theta = float(consts.get("theta", 10000.0))
+    offset = int(consts.get("offset", 0))
+    inv = 1.0 / (theta ** (np.arange(0, d, 2, dtype=np.float64) / d))
+    pos = (offset + np.arange(s, dtype=np.float64))[None, :]
+    freqs = pos[..., None] * inv                      # [1, s, d/2]
+    cos = ctx.add_init(ctx.fresh("rope_cos"),
+                       np.cos(freqs)[:, :, None, :].astype(dt))
+    sin = ctx.add_init(ctx.fresh("rope_sin"),
+                       np.sin(freqs)[:, :, None, :].astype(dt))
+    even = ctx.add_init(ctx.fresh("starts"), np.asarray([0], np.int64))
+    odd = ctx.add_init(ctx.fresh("starts"), np.asarray([1], np.int64))
+    ends = ctx.add_init(ctx.fresh("ends"),
+                        np.asarray([2**31 - 1], np.int64))
+    ax3 = ctx.add_init(ctx.fresh("axes"), np.asarray([3], np.int64))
+    two = ctx.add_init(ctx.fresh("steps"), np.asarray([2], np.int64))
+    last = ctx.add_init(ctx.fresh("axes"), np.asarray([4], np.int64))
+
+    for x_name, x_arr, out in zip(ins, arrs, outs):
+        xs = tuple(_np(x_arr).shape)
+        x1 = ctx.fresh("rope_x1")
+        ctx.node("Slice", [x_name, even, ends, ax3, two], [x1])
+        x2 = ctx.fresh("rope_x2")
+        ctx.node("Slice", [x_name, odd, ends, ax3, two], [x2])
+        a = ctx.fresh("rope_a")
+        ctx.node("Mul", [x1, cos], [a])
+        bb = ctx.fresh("rope_b")
+        ctx.node("Mul", [x2, sin], [bb])
+        r1 = ctx.fresh("rope_r1")
+        ctx.node("Sub", [a, bb], [r1])
+        c = ctx.fresh("rope_c")
+        ctx.node("Mul", [x2, cos], [c])
+        dd = ctx.fresh("rope_d")
+        ctx.node("Mul", [x1, sin], [dd])
+        r2 = ctx.fresh("rope_r2")
+        ctx.node("Add", [c, dd], [r2])
+        u1 = ctx.fresh("rope_u1")
+        ctx.node("Unsqueeze", [r1, last], [u1])
+        u2 = ctx.fresh("rope_u2")
+        ctx.node("Unsqueeze", [r2, last], [u2])
+        st = ctx.fresh("rope_st")
+        ctx.node("Concat", [u1, u2], [st], axis=4)
+        sh = ctx.add_init(ctx.fresh("shape"),
+                          np.asarray([0] + list(xs[1:]), np.int64))
+        ctx.node("Reshape", [st, sh], [out])
+
+
+def _e_neg(ctx, ins, consts, outs, arrs):
+    ctx.node("Neg", ins, outs)
+
+
+def _e_where(ctx, ins, consts, outs, arrs):
+    ctx.node("Where", ins, outs)
+
+
 _EMIT = {
     "matmul": _e_matmul,
+    "unbind": _e_unbind,
+    "rms_norm": _e_rms_norm,
+    "silu": _e_silu,
+    "swish": _e_silu,
+    "stack": _e_stack,
+    "split": _e_split,
+    "neg": _e_neg,
+    "where": _e_where,
+    "rope": _e_rope,
     "add": _e_elementwise("Add"), "subtract": _e_elementwise("Sub"),
     "multiply": _e_elementwise("Mul"), "divide": _e_elementwise("Div"),
     "pow": _e_elementwise("Pow"), "maximum": _e_elementwise("Max"),
@@ -436,10 +633,12 @@ def export(layer, path, input_spec=None, opset_version=17, **configs):
     if input_spec is None:
         raise ValueError("onnx.export requires input_spec (InputSpec or "
                          "example tensors)")
-    if int(opset_version) < 13:
+    if not 13 <= int(opset_version) <= 17:
         raise NotImplementedError(
-            f"onnx export targets opset >= 13 (LayerNormalization et al.); "
-            f"got {opset_version}")
+            "onnx export emits opset 13-17 constructs (ReduceMean "
+            "axes-as-attribute, equal Split without num_outputs; "
+            "LayerNormalization needs >= 17) — got opset "
+            f"{opset_version}; use 17")
 
     examples, graph_inputs = [], []
     for i, spec in enumerate(input_spec):

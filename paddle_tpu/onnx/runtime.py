@@ -83,7 +83,13 @@ def _pool(x, at, mode):
     import jax.numpy as jnp
     kh, kw = at["kernel_shape"]
     sh, sw = at.get("strides", at["kernel_shape"])
-    pads = at.get("pads", [0, 0, 0, 0])
+    pads = list(at.get("pads", [0, 0, 0, 0]))
+    if at.get("ceil_mode"):
+        # extend end-padding so the window grid covers the ceil output
+        for d, (k, s, end_i) in enumerate(((kh, sh, 2), (kw, sw, 3))):
+            size = x.shape[2 + d] + pads[d] + pads[end_i]
+            out = -(-(size - k) // s) + 1          # ceil
+            pads[end_i] += max(0, (out - 1) * s + k - size)
     pad = [(0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3])]
     xa = jnp.asarray(x)
     if mode == "max":
@@ -150,9 +156,8 @@ def evaluate(model, inputs):
         elif op == "Abs":
             r = np.abs(ins[0])
         elif op == "Erf":
-            import math
-            r = np.vectorize(math.erf)(
-                ins[0].astype(np.float64)).astype(ins[0].dtype)
+            from jax.scipy.special import erf as _jerf
+            r = np.asarray(_jerf(ins[0]))
         elif op == "Softmax":
             r = _softmax(ins[0], int(at.get("axis", -1)))
         elif op == "LayerNormalization":
@@ -222,8 +227,25 @@ def evaluate(model, inputs):
             r = ins[0].astype(_NP_DT[int(at["to"])])
         elif op == "Identity":
             r = ins[0]
+        elif op == "Neg":
+            r = -ins[0]
+        elif op == "Tile":
+            r = np.tile(ins[0], [int(x) for x in ins[1]])
+        elif op == "Where":
+            r = np.where(ins[0], ins[1], ins[2])
+        elif op == "Split":
+            ax = int(at.get("axis", 0))
+            if len(ins) > 1 and ins[1] is not None:
+                sizes = [int(s) for s in ins[1]]
+                r = np.split(ins[0], np.cumsum(sizes)[:-1], axis=ax)
+            else:
+                r = np.split(ins[0], len(node.output), axis=ax)
         else:
             raise NotImplementedError(f"onnx runtime: op {op}")
-        env[node.output[0]] = r
+        if len(node.output) > 1:
+            for nm, part in zip(node.output, r):
+                env[nm] = part
+        else:
+            env[node.output[0]] = r
 
     return [env[vi.name] for vi in g.output]

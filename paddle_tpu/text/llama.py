@@ -120,11 +120,15 @@ class LlamaAttention(nn.Layer):
             if cache is not None:
                 offset = cache["k"].shape[1]
 
-            def rope_fn(qa, ka, offset=offset, theta=cfg.rope_theta):
+            def rope_fn(qa, ka, offset, theta):
                 pos = (offset + jnp.arange(qa.shape[1]))[None, :]
                 return _rope(qa, ka, pos, theta)
 
-            q, k = engine.apply("rope", rope_fn, [q, k])
+            # offset/theta ride in consts so graph capture (onnx export)
+            # can rebuild the rotation tables
+            q, k = engine.apply("rope", rope_fn, [q, k],
+                                {"offset": offset,
+                                 "theta": cfg.rope_theta})
 
         mask = None
         if prealloc:

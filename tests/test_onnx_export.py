@@ -74,6 +74,81 @@ class TestOnnxExport:
         ops = [n.op_type for n in model.graph.node]
         assert "Gather" in ops and "Softmax" in ops   # embedding + sdpa
 
+    def test_gpt_tiny_roundtrip(self, tmp_path):
+        pt.seed(4)
+        from paddle_tpu.text import GPTConfig, GPTForCausalLM
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=4, max_position_embeddings=16,
+                        hidden_dropout=0.0, attention_dropout=0.0)
+        m = GPTForCausalLM(cfg)
+        ids = pt.to_tensor(np.arange(16, dtype=np.int64)[None, :] % 64)
+        model = _roundtrip(m, [ids], tmp_path, rtol=1e-3, atol=1e-4)
+        ops = [n.op_type for n in model.graph.node]
+        assert "Split" in ops     # fused qkv unbind
+
+    def test_llama_gqa_rope_roundtrip(self, tmp_path):
+        # covers rms_norm/silu/rope decompositions + GQA head tiling
+        pt.seed(5)
+        from paddle_tpu.text.llama import LlamaConfig, LlamaForCausalLM
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                          num_heads=4, num_kv_heads=2, intermediate_size=64,
+                          max_position_embeddings=16)
+        m = LlamaForCausalLM(cfg)
+        ids = pt.to_tensor(np.arange(16, dtype=np.int64)[None, :] % 64)
+        model = _roundtrip(m, [ids], tmp_path, rtol=1e-3, atol=1e-4)
+        ops = [n.op_type for n in model.graph.node]
+        assert "Tile" in ops and "Erf" not in ops   # GQA; silu not gelu
+
+    def test_tanh_gelu_honored(self, tmp_path):
+        # GPT uses approximate (tanh) gelu; the emitter must not silently
+        # substitute erf-gelu (~5e-4 deviation per activation)
+        class G(pt.nn.Layer):
+            def forward(self, x):
+                return pt.nn.functional.gelu(x, approximate=True)
+
+        x = pt.to_tensor(np.linspace(-3, 3, 64, dtype=np.float32)
+                         .reshape(8, 8))
+        model = _roundtrip(G(), [x], tmp_path, rtol=1e-6, atol=1e-6)
+        ops = [n.op_type for n in model.graph.node]
+        assert "Tanh" in ops and "Erf" not in ops
+
+    def test_ceil_mode_pool_roundtrip(self, tmp_path):
+        m = pt.nn.MaxPool2D(3, stride=2, ceil_mode=True)
+        _roundtrip(m, [pt.rand([1, 2, 7, 7])], tmp_path)
+
+    def test_negative_step_slice_raises(self, tmp_path):
+        class R(pt.nn.Layer):
+            def forward(self, x):
+                return x[::-1] * 1.0
+
+        with pytest.raises(NotImplementedError, match="negative-step"):
+            ponnx.export(R(), str(tmp_path / "rev"),
+                         input_spec=[pt.rand([4, 3])])
+
+    def test_opset_18_rejected(self, tmp_path):
+        with pytest.raises(NotImplementedError, match="opset"):
+            ponnx.export(pt.nn.Linear(2, 2), str(tmp_path / "o18"),
+                         input_spec=[pt.rand([1, 2])], opset_version=18)
+
+    def test_gpt_dynamic_batch(self, tmp_path):
+        # Reshape targets emit batch as 0 ("copy from input"), so a graph
+        # traced at batch 1 with a dim_param input runs at any batch
+        pt.seed(6)
+        from paddle_tpu.static import InputSpec
+        from paddle_tpu.text import GPTConfig, GPTForCausalLM
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_heads=4, max_position_embeddings=8,
+                        hidden_dropout=0.0, attention_dropout=0.0)
+        m = GPTForCausalLM(cfg)
+        path = ponnx.export(m, str(tmp_path / "gptdyn"),
+                            input_spec=[InputSpec([None, 8], "int64")])
+        ids = np.random.RandomState(0).randint(0, 64, (3, 8)).astype(np.int64)
+        got = ponnx.run(path, [ids])[0]
+        m.eval()
+        with pt.no_grad():
+            want = m(pt.to_tensor(ids)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
     def test_unsupported_op_raises_with_name(self, tmp_path):
         class Odd(pt.nn.Layer):
             def forward(self, x):
