@@ -179,7 +179,7 @@ def bubble_fraction(n_stages, n_microbatches, n_chunks=1):
 
 
 def interleaved_hybrid(block_apply, n_stages, n_microbatches, n_chunks,
-                       axis_name="pp"):
+                       axis_name="pp", mutable_bufs=False):
     """Interleaved (circular) pipeline schedule — the TPU-SPMD analog of
     Megatron/Fleet's interleaved 1F1B "virtual pipeline stages" (reference:
     python/paddle/distributed/fleet/meta_parallel/pp_utils +
@@ -210,7 +210,7 @@ def interleaved_hybrid(block_apply, n_stages, n_microbatches, n_chunks,
 
     def pipelined(stacked_params, x_mb, key):
         # under shard_map the pp axis is manual: leading dim == 1 here
-        my_params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+        my_params, my_bufs = _device_tree(stacked_params, mutable_bufs)
         n_rows = jax.tree_util.tree_leaves(my_params)[0].shape[0]
         if n_rows % V:
             raise ValueError(
@@ -227,26 +227,37 @@ def interleaved_hybrid(block_apply, n_stages, n_microbatches, n_chunks,
 
         aux_acc = jnp.zeros((), jnp.float32)
 
-        def chunk_params(v):
+        def chunk_tree(tree, v):
             return jax.tree_util.tree_map(
                 lambda a: lax.dynamic_slice_in_dim(a, v * lpc, lpc, 0),
-                my_params)
+                tree)
 
-        def stage_fn(cparams, x, v, k):
+        def stage_fn(cparams, cbufs, x, v, k):
             def scan_block(carry, xs):
-                h, aux = carry
+                h, aux, bstack = carry
                 layer_params, li = xs
                 kk = jax.random.fold_in(k, v * lpc + li)
-                y, a = block_apply(layer_params, h, kk)
-                return (y, aux + a), None
+                row = {n: lax.dynamic_index_in_dim(b, li, 0, keepdims=False)
+                       for n, b in bstack.items()}
+                out = block_apply(
+                    {**layer_params, **row} if row else layer_params, h, kk)
+                if len(out) == 3:
+                    y, a, newb = out
+                    if newb:
+                        bstack = {n: lax.dynamic_update_index_in_dim(
+                            bstack[n], newb[n].astype(bstack[n].dtype),
+                            li, 0) for n in bstack}
+                else:
+                    y, a = out
+                return (y, aux + a, bstack), None
 
-            (y, aux), _ = lax.scan(scan_block,
-                                   (x, jnp.zeros((), jnp.float32)),
-                                   (cparams, jnp.arange(lpc)))
-            return y, aux
+            (y, aux, bstack), _ = lax.scan(
+                scan_block, (x, jnp.zeros((), jnp.float32), cbufs),
+                (cparams, jnp.arange(lpc)))
+            return y, aux, bstack
 
         def body(carry, t):
-            state, out_buf, fifo, aux_acc = carry
+            state, out_buf, fifo, aux_acc, bufs = carry
             rel = t - idx
             v = jnp.clip(rel // M, 0, V - 1)
             m = jnp.clip(rel % M, 0, M - 1)
@@ -265,11 +276,18 @@ def interleaved_hybrid(block_apply, n_stages, n_microbatches, n_chunks,
             h = jnp.where(idx == 0, h0, state)
             # no cond bubble-skip in the differentiable schedule — see
             # the gpipe_hybrid note (grad-through-cond memory blowup)
-            y, aux = stage_fn(chunk_params(v), h, v,
-                              jax.random.fold_in(key, t))
+            cb = chunk_tree(bufs, v)
+            y, aux, new_cb = stage_fn(chunk_tree(my_params, v), cb, h, v,
+                                      jax.random.fold_in(key, t))
             # device idx works (chunk v, microbatch m) when 0 <= t-idx < V*M
             active = (rel >= 0) & (rel < V * M)
             aux_acc = aux_acc + jnp.where(active, aux, 0.0)
+            # buffer updates (BN running stats) commit per ACTIVE step in
+            # (chunk, microbatch) order — serial semantics per chunk row
+            bufs = {n: lax.dynamic_update_slice_in_dim(
+                        bufs[n], jnp.where(active, new_cb[n], cb[n]),
+                        v * lpc, 0)
+                    for n in bufs}
             m_emit = jnp.clip(t - (V - 1) * M - (P_ - 1), 0, M - 1)
             is_emit = (idx == P_ - 1) & (t >= (V - 1) * M + P_ - 1)
             prev = lax.dynamic_index_in_dim(out_buf, m_emit, 0,
@@ -278,14 +296,17 @@ def interleaved_hybrid(block_apply, n_stages, n_microbatches, n_chunks,
                 out_buf, jnp.where(is_emit, y, prev), m_emit, 0)
             perm = [(i, (i + 1) % P_) for i in range(P_)]
             state = lax.ppermute(y, axis_name, perm)
-            return (state, out_buf, fifo, aux_acc), None
+            return (state, out_buf, fifo, aux_acc, bufs), None
 
-        (state, out_buf, fifo, aux_acc), _ = lax.scan(
-            body, (state, out_buf, fifo, aux_acc), jnp.arange(T))
+        (state, out_buf, fifo, aux_acc, bufs), _ = lax.scan(
+            body, (state, out_buf, fifo, aux_acc, my_bufs), jnp.arange(T))
         out = lax.psum(
             jnp.where(idx == P_ - 1, out_buf,
                       jnp.zeros_like(out_buf)), axis_name)
         aux_total = lax.psum(aux_acc, axis_name)
+        if mutable_bufs:
+            return (out[None], aux_total,
+                    {n: lax.stop_gradient(b)[None] for n, b in bufs.items()})
         return out[None], aux_total
 
     return pipelined
@@ -751,16 +772,14 @@ def pipeline_apply_hybrid(block_apply, stacked_params, x_mb, key, mesh,
     n_chunks > 1); must be called inside jit (the fleet engine's pjit
     step).  x_mb: [M, mb, ...]; returns ([M, mb, ...], aux_total) where
     aux_total sums block aux losses (MoE routers) over all stages and
-    microbatches.  mutable_bufs (GPipe only): returns a third output —
-    the committed 'buf::' stacked updates (BN running stats)."""
+    microbatches.  mutable_bufs: returns a third output — the committed
+    'buf::' stacked updates (BN running stats), threaded per active
+    (chunk, microbatch) step in both schedules (round 4: interleaved
+    too, closing the last read-only pp restriction)."""
     if n_chunks > 1:
-        if mutable_bufs:
-            raise NotImplementedError(
-                "mutable block buffers are not supported by the "
-                "interleaved (n_chunks > 1) schedule — use n_chunks=1 "
-                "(GPipe/1F1B) for BN-bearing pipelined blocks")
         fn = interleaved_hybrid(block_apply, n_stages, n_microbatches,
-                                n_chunks, axis_name)
+                                n_chunks, axis_name,
+                                mutable_bufs=mutable_bufs)
     else:
         fn = gpipe_hybrid(block_apply, n_stages, n_microbatches, axis_name,
                           mutable_bufs=mutable_bufs)

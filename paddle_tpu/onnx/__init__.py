@@ -467,8 +467,10 @@ def _e_unbind(ctx, ins, consts, outs, arrs):
 
 
 def _e_rms_norm(ctx, ins, consts, outs, arrs):
-    # x * w / sqrt(mean(x^2, -1) + eps) — ONNX has no RMSNorm core op
-    x, w = ins[:2]
+    # x * w / sqrt(mean(x^2, -1) + eps) — ONNX has no RMSNorm core op;
+    # weight may be absent (F.rms_norm(x) without a scale)
+    x = ins[0]
+    w = ins[1] if len(ins) > 1 else None
     dt = _np(arrs[0]).dtype
     sq = ctx.fresh("rms_sq")
     ctx.node("Mul", [x, x], [sq])
@@ -479,9 +481,12 @@ def _e_rms_norm(ctx, ins, consts, outs, arrs):
         np.asarray(consts.get("eps", 1e-6), dt))], [stable])
     root = ctx.fresh("rms_sqrt")
     ctx.node("Sqrt", [stable], [root])
-    normed = ctx.fresh("rms_normed")
-    ctx.node("Div", [x, root], [normed])
-    ctx.node("Mul", [normed, w], outs)
+    if w is None:
+        ctx.node("Div", [x, root], outs)
+    else:
+        normed = ctx.fresh("rms_normed")
+        ctx.node("Div", [x, root], [normed])
+        ctx.node("Mul", [normed, w], outs)
 
 
 def _e_silu(ctx, ins, consts, outs, arrs):
@@ -505,8 +510,13 @@ def _e_split(ctx, ins, consts, outs, arrs):
     ax = int(consts.get("axis", 0))
     sections = consts.get("num_or_sections")
     if isinstance(sections, (list, tuple)):
+        sections = [int(s) for s in sections]
+        if any(s < 0 for s in sections):   # resolve the one "infer" slot
+            total = int(_np(arrs[0]).shape[ax])
+            rest = total - sum(s for s in sections if s >= 0)
+            sections = [rest if s < 0 else s for s in sections]
         sp = ctx.add_init(ctx.fresh("split"),
-                          np.asarray(list(sections), np.int64))
+                          np.asarray(sections, np.int64))
         ctx.node("Split", [ins[0], sp], outs, axis=ax)
     else:
         ctx.node("Split", ins, outs, axis=ax)

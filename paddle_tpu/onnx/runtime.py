@@ -156,8 +156,13 @@ def evaluate(model, inputs):
         elif op == "Abs":
             r = np.abs(ins[0])
         elif op == "Erf":
-            from jax.scipy.special import erf as _jerf
-            r = np.asarray(_jerf(ins[0]))
+            if ins[0].dtype == np.float64:
+                # jax computes in f32 without x64; keep double precision
+                import math
+                r = np.vectorize(math.erf)(ins[0])
+            else:
+                from jax.scipy.special import erf as _jerf
+                r = np.asarray(_jerf(ins[0])).astype(ins[0].dtype)
         elif op == "Softmax":
             r = _softmax(ins[0], int(at.get("axis", -1)))
         elif op == "LayerNormalization":

@@ -113,8 +113,10 @@ class TestOnnxExport:
         assert "Tanh" in ops and "Erf" not in ops
 
     def test_ceil_mode_pool_roundtrip(self, tmp_path):
+        # 6x6 with k=3 s=2: floor gives 2, ceil gives 3 — the sizes
+        # diverge, so this actually exercises the evaluator's ceil branch
         m = pt.nn.MaxPool2D(3, stride=2, ceil_mode=True)
-        _roundtrip(m, [pt.rand([1, 2, 7, 7])], tmp_path)
+        _roundtrip(m, [pt.rand([1, 2, 6, 6])], tmp_path)
 
     def test_negative_step_slice_raises(self, tmp_path):
         class R(pt.nn.Layer):
