@@ -33,6 +33,14 @@ def main():
     args = ap.parse_args()
 
     if args.cpu:
+        # self-provision the virtual device mesh (jax reads XLA_FLAGS at
+        # first import, which happens below, after arg parsing)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            n = args.dp * args.mp * args.pp
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
         import jax
         jax.config.update("jax_platforms", "cpu")
 

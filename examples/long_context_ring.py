@@ -36,6 +36,14 @@ def main():
     args = ap.parse_args()
 
     if args.cpu:
+        # self-provision the virtual device mesh (jax reads XLA_FLAGS at
+        # first import, below)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={args.sp}"
+            ).strip()
         import jax
         jax.config.update("jax_platforms", "cpu")
     import paddle_tpu as pt

@@ -233,28 +233,10 @@ def interleaved_hybrid(block_apply, n_stages, n_microbatches, n_chunks,
                 tree)
 
         def stage_fn(cparams, cbufs, x, v, k):
-            def scan_block(carry, xs):
-                h, aux, bstack = carry
-                layer_params, li = xs
-                kk = jax.random.fold_in(k, v * lpc + li)
-                row = {n: lax.dynamic_index_in_dim(b, li, 0, keepdims=False)
-                       for n, b in bstack.items()}
-                out = block_apply(
-                    {**layer_params, **row} if row else layer_params, h, kk)
-                if len(out) == 3:
-                    y, a, newb = out
-                    if newb:
-                        bstack = {n: lax.dynamic_update_index_in_dim(
-                            bstack[n], newb[n].astype(bstack[n].dtype),
-                            li, 0) for n in bstack}
-                else:
-                    y, a = out
-                return (y, aux + a, bstack), None
-
-            (y, aux, bstack), _ = lax.scan(
-                scan_block, (x, jnp.zeros((), jnp.float32), cbufs),
-                (cparams, jnp.arange(lpc)))
-            return y, aux, bstack
+            # delegate to the shared per-device layer scan; the key offset
+            # v*lpc keeps per-layer randomness distinct across chunks
+            return _stage_scan(block_apply, cparams, x, k, bufs=cbufs,
+                               layer_index_base=v * lpc)
 
         def body(carry, t):
             state, out_buf, fifo, aux_acc, bufs = carry
@@ -331,7 +313,8 @@ def _device_tree(stacked_params, mutable_bufs):
     return _split_bufs(my_all)
 
 
-def _stage_scan(block_apply, stage_params, x, key_m, bufs=None):
+def _stage_scan(block_apply, stage_params, x, key_m, bufs=None,
+                layer_index_base=0):
     """One device's layers on one microbatch; per-layer key folded from the
     MICROBATCH key (not the schedule step) so the 1F1B backward can replay
     the exact forward randomness during recompute.
@@ -351,7 +334,8 @@ def _stage_scan(block_apply, stage_params, x, key_m, bufs=None):
         row = {n: lax.dynamic_index_in_dim(b, li, 0, keepdims=False)
                for n, b in bstack.items()}
         out = block_apply({**layer_params, **row} if row else layer_params,
-                          h, jax.random.fold_in(key_m, li))
+                          h, jax.random.fold_in(key_m,
+                                                layer_index_base + li))
         if len(out) == 3:
             y, a, newb = out
             if newb:

@@ -510,11 +510,18 @@ def _e_split(ctx, ins, consts, outs, arrs):
     ax = int(consts.get("axis", 0))
     sections = consts.get("num_or_sections")
     if isinstance(sections, (list, tuple)):
+        # resolve the "infer" slot with the SAME rule as the live kernel
+        # (ops/kernels.py _split: exactly -1 infers; other negatives are
+        # invalid there and must not silently serialize here)
         sections = [int(s) for s in sections]
-        if any(s < 0 for s in sections):   # resolve the one "infer" slot
+        if any(s == -1 for s in sections):
             total = int(_np(arrs[0]).shape[ax])
-            rest = total - sum(s for s in sections if s >= 0)
-            sections = [rest if s < 0 else s for s in sections]
+            known = sum(s for s in sections if s != -1)
+            sections = [total - known if s == -1 else s for s in sections]
+        if any(s < 0 for s in sections):
+            raise NotImplementedError(
+                f"onnx export: split sections {sections} (only -1 may be "
+                "negative)")
         sp = ctx.add_init(ctx.fresh("split"),
                           np.asarray(sections, np.int64))
         ctx.node("Split", [ins[0], sp], outs, axis=ax)

@@ -159,7 +159,7 @@ def evaluate(model, inputs):
             if ins[0].dtype == np.float64:
                 # jax computes in f32 without x64; keep double precision
                 import math
-                r = np.vectorize(math.erf)(ins[0])
+                r = np.vectorize(math.erf, otypes=[np.float64])(ins[0])
             else:
                 from jax.scipy.special import erf as _jerf
                 r = np.asarray(_jerf(ins[0])).astype(ins[0].dtype)

@@ -151,6 +151,21 @@ class TestOnnxExport:
             want = m(pt.to_tensor(ids)).numpy()
         np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
 
+    def test_split_with_infer_section(self, tmp_path):
+        # paddle.split(x, [2, -1], axis=1): the -1 must be resolved before
+        # serialization (ONNX Split rejects negative section lengths)
+        class S(pt.nn.Layer):
+            def forward(self, x):
+                a, b = pt.split(x, [2, -1], axis=1)
+                return a.sum(axis=1, keepdim=True) + b.sum(axis=1,
+                                                           keepdim=True)
+
+        model = _roundtrip(S(), [pt.rand([3, 6])], tmp_path)
+        split_init = [i for i in model.graph.initializer
+                      if i.name.startswith("split")]
+        assert split_init and all(
+            v >= 0 for v in np.frombuffer(split_init[0].raw_data, np.int64))
+
     def test_unsupported_op_raises_with_name(self, tmp_path):
         class Odd(pt.nn.Layer):
             def forward(self, x):
