@@ -208,6 +208,38 @@ def run_gpt(preset, seq_len, batch, steps=20, warmup=3, **cfg_kw):
             "step_times_s": stimes, "devices": _dev_str()}
 
 
+def run_gpt_decode(preset="gpt3-125M", batch=8, prompt=128, new_tokens=128,
+                   rounds=3):
+    """Generation throughput: jitted prefill+KV-cache greedy decode
+    (text/decode.py jit_generate) — the deployment-side complement of the
+    training legs. Reports decoded tokens/s/chip."""
+    import paddle_tpu as pt
+    from paddle_tpu.text import GPTConfig, GPTForCausalLM
+    from paddle_tpu.text.decode import jit_generate
+
+    pt.seed(0)
+    cfg = GPTConfig.from_preset(
+        preset, vocab_size=50304,
+        max_position_embeddings=prompt + new_tokens,
+        hidden_dropout=0.0, attention_dropout=0.0, tensor_parallel=False)
+    with pt.LazyGuard():
+        model = GPTForCausalLM(cfg)
+    model = pt.amp.decorate(models=model, dtype="bfloat16")
+    ids = pt.randint(0, cfg.vocab_size, [batch, prompt])
+
+    out = jit_generate(model, ids, max_new_tokens=new_tokens)  # compile
+    int(out._array[0, -1])  # host read: the only reliable tunnel sync
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        out = jit_generate(model, ids, max_new_tokens=new_tokens)
+    int(out._array[0, -1])
+    dt = time.perf_counter() - t0
+    n_params = sum(p.size for p in model.parameters())
+    return {"tps": batch * new_tokens * rounds / dt,
+            "n_params": int(n_params), "batch": batch, "prompt": prompt,
+            "new_tokens": new_tokens, "devices": _dev_str()}
+
+
 def _dev_str():
     import jax
     try:
@@ -408,7 +440,8 @@ def run_ernie_infer(steps=30, warmup=5, batch=32, seq=128,
 
 CHILD_FNS = {"gpt": run_gpt, "resnet": run_resnet, "llama": run_llama,
              "moe": run_moe, "bert": run_bert,
-             "ernie_infer": run_ernie_infer}
+             "ernie_infer": run_ernie_infer,
+             "gpt_decode": run_gpt_decode}
 
 
 def _child_main(spec):
@@ -678,6 +711,23 @@ def main():
                           "(deployment API, seq128)",
                 "value": round(res["sps"], 1), "unit": "samples/s/chip",
                 "vs_baseline": round(res["sps"] / base_sps, 3)}))
+    if _left() > 400:
+        # generation: jitted prefill + KV-cache greedy decode. Decode is
+        # memory-bandwidth-bound (2 bytes/param/token in bf16), so the
+        # derived bar is A100 HBM 2.0 TB/s x 60% util / 2N bytes/token
+        res = _spawn({"kind": "gpt_decode"}, min(PRESET_TIMEOUT, _left()))
+        if res:
+            record["legs"]["gpt_decode"] = res
+            # one decode step reads the params once (2N bf16 bytes) and
+            # emits `batch` tokens, so the batched roofline scales with
+            # batch; ignoring KV-cache reads makes the bar slightly
+            # GENEROUS (harder to beat), which is the honest direction
+            base = res["batch"] * 2.0e12 * 0.60 / (2.0 * res["n_params"])
+            _log(json.dumps({
+                "metric": "GPT-125M greedy decode tokens/sec/chip "
+                          "(KV-cache, batch 8)",
+                "value": round(res["tps"], 1), "unit": "tokens/s/chip",
+                "vs_baseline": round(res["tps"] / base, 3)}))
     if _left() > 500 and os.environ.get("BENCH_SKIP_27B") != "1":
         # model-ladder leg above the headline (VERDICT r2 item 8):
         # GPT-2.7B, Adafactor + recompute + pure bf16 (~5.4GB params)

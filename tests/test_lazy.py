@@ -131,6 +131,35 @@ class TestLazyGuard:
         np.testing.assert_array_equal(a.weight.numpy(), b.weight.numpy())
         assert b.weight._array is not a.weight._array
 
+    def test_lazy_with_tensor_parallel_fleet(self):
+        # tp layers create params through Layer.create_parameter, so a
+        # LazyGuard build must materialize before pjit shards them
+        from paddle_tpu.distributed import fleet, mesh as mesh_mod
+        from paddle_tpu.text import GPTConfig, GPTForCausalLM, gpt_loss_fn
+        prev = dict(mesh_mod._state)
+        try:
+            strategy = fleet.DistributedStrategy()
+            strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                                       "pp_degree": 1}
+            fleet.init(is_collective=True, strategy=strategy)
+            pt.seed(0)
+            cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                            num_heads=4, max_position_embeddings=16,
+                            tensor_parallel=True, hidden_dropout=0.0,
+                            attention_dropout=0.0)
+            with pt.LazyGuard():
+                m = GPTForCausalLM(cfg)
+            opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+            step = fleet.build_train_step(m, gpt_loss_fn, opt)
+            ids = pt.randint(0, 64, [4, 16])
+            l0 = float(step(ids, ids))
+            for _ in range(4):
+                l = float(step(ids, ids))
+            assert l < l0
+        finally:
+            mesh_mod._state.update(prev)
+
     def test_train_after_lazy_build(self):
         pt.seed(3)
         with pt.LazyGuard():
