@@ -227,15 +227,29 @@ def run_gpt_decode(preset="gpt3-125M", batch=8, prompt=128, new_tokens=128,
     model = pt.amp.decorate(models=model, dtype="bfloat16")
     ids = pt.randint(0, cfg.vocab_size, [batch, prompt])
 
+    # the decode rate must not be polluted by prefill wall time: measure
+    # (prefill + N tokens) and (prefill + 1 token) and difference them,
+    # crediting the N-1 extra decode steps
     out = jit_generate(model, ids, max_new_tokens=new_tokens)  # compile
     int(out._array[0, -1])  # host read: the only reliable tunnel sync
+    pre = jit_generate(model, ids, max_new_tokens=1)            # compile
+    int(pre._array[0, -1])
+
     t0 = time.perf_counter()
     for _ in range(rounds):
         out = jit_generate(model, ids, max_new_tokens=new_tokens)
     int(out._array[0, -1])
-    dt = time.perf_counter() - t0
+    dt_full = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        pre = jit_generate(model, ids, max_new_tokens=1)
+    int(pre._array[0, -1])
+    dt_pre = time.perf_counter() - t0
+
+    dt_decode = max(dt_full - dt_pre, 1e-6)
     n_params = sum(p.size for p in model.parameters())
-    return {"tps": batch * new_tokens * rounds / dt,
+    return {"tps": batch * (new_tokens - 1) * rounds / dt_decode,
+            "prefill_s": dt_pre / rounds,
             "n_params": int(n_params), "batch": batch, "prompt": prompt,
             "new_tokens": new_tokens, "devices": _dev_str()}
 

@@ -151,6 +151,29 @@ class TestOnnxExport:
             want = m(pt.to_tensor(ids)).numpy()
         np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
 
+    def test_ernie_cls_roundtrip(self, tmp_path):
+        pt.seed(7)
+        from paddle_tpu.text.ernie import (ErnieConfig,
+                                           ErnieForSequenceClassification)
+        cfg = ErnieConfig(vocab_size=64, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          intermediate_size=64, max_position_embeddings=32)
+        m = ErnieForSequenceClassification(cfg, num_classes=3)
+        ids = pt.to_tensor(np.arange(8, dtype=np.int64)[None, :] % 64)
+        _roundtrip(m, [ids], tmp_path, rtol=1e-3, atol=1e-4)
+
+    def test_seq2seq_mt_roundtrip(self, tmp_path):
+        # encoder-decoder with cross-attention (masked sdpa decomposition)
+        pt.seed(8)
+        from paddle_tpu.text.transformer_mt import TransformerModel
+        m = TransformerModel(src_vocab_size=64, trg_vocab_size=64,
+                             max_length=16, num_encoder_layers=1,
+                             num_decoder_layers=1, n_head=2, d_model=32,
+                             d_inner_hid=64, dropout=0.0)
+        src = pt.to_tensor(np.arange(8, dtype=np.int64)[None, :] % 64)
+        trg = pt.to_tensor((np.arange(8, dtype=np.int64)[None, :] + 1) % 64)
+        _roundtrip(m, [src, trg], tmp_path, rtol=1e-3, atol=1e-4)
+
     def test_split_with_infer_section(self, tmp_path):
         # paddle.split(x, [2, -1], axis=1): the -1 must be resolved before
         # serialization (ONNX Split rejects negative section lengths)
