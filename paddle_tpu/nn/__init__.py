@@ -52,3 +52,5 @@ from .extras_r3 import (  # noqa: F401
 # reference spelling aliases the API audit surfaced
 Silu = SiLU
 MaxUnPool2D = MaxUnpool2D
+
+from . import quant  # noqa: F401,E402  (paddle.nn.quant weight-only)

@@ -1,0 +1,168 @@
+"""paddle.nn.quant — weight-only quantization for LLM serving
+(reference: python/paddle/nn/quant/quantized_linear.py weight_quantize /
+weight_only_linear, and WeightOnlyLinear in paddlenlp's inference stack).
+
+TPU-native design: the quantized weight is a plain int8 (or nibble-packed
+int4) array with per-output-channel fp scales; ``weight_only_linear``
+dequantizes INSIDE the op (``w.astype(compute_dtype) * scale``) so XLA
+fuses the dequant into the matmul's weight load — HBM traffic drops by
+2x/4x (the decode bottleneck) while the MXU still sees bf16 operands.
+No custom kernels needed: this is exactly the shape the compiler fuses.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..tensor_api import _t
+from ..autograd import engine
+
+
+def _absmax_scale(w, axis):
+    s = jnp.max(jnp.abs(w), axis=axis, keepdims=False)
+    return jnp.where(s == 0, 1.0, s)
+
+
+def weight_quantize(x, algo="weight_only_int8"):
+    """Quantize a [in, out] weight matrix for weight-only inference.
+
+    Returns (quantized_weight, scale) Tensors:
+      * int8: out[k, n] int8, scale[n] fp32 — w ≈ q * scale / 127
+      * int4: two values packed per int8 byte along the IN axis
+        (out[ceil(k/2), n]), scale[n] fp32 — w ≈ nibble * scale / 7
+    """
+    w = _t(x)._array.astype(jnp.float32)
+    if w.ndim != 2:
+        raise ValueError(f"weight_quantize expects 2-D weights, got "
+                         f"{w.shape}")
+    if algo == "weight_only_int8":
+        scale = _absmax_scale(w, axis=0)                     # [n]
+        q = jnp.clip(jnp.round(w / scale * 127.0), -127, 127)
+        return (Tensor._from_array(q.astype(jnp.int8)),
+                Tensor._from_array(scale))
+    if algo == "weight_only_int4":
+        scale = _absmax_scale(w, axis=0)
+        q = jnp.clip(jnp.round(w / scale * 7.0), -7, 7).astype(jnp.int8)
+        k = q.shape[0]
+        if k % 2:
+            q = jnp.concatenate(
+                [q, jnp.zeros((1, q.shape[1]), jnp.int8)], axis=0)
+        lo = q[0::2] & 0x0F                  # low nibble: even rows
+        hi = (q[1::2] & 0x0F) << 4           # high nibble: odd rows
+        return (Tensor._from_array((lo | hi).astype(jnp.int8)),
+                Tensor._from_array(scale))
+    raise ValueError(f"unknown weight_quantize algo {algo!r}")
+
+
+def _unpack_int4(packed, k):
+    """Inverse of the nibble packing: [ceil(k/2), n] int8 -> [k, n] int8
+    with sign extension (values were clipped to [-7, 7])."""
+    lo = (packed & 0x0F).astype(jnp.int8)
+    hi = ((packed >> 4) & 0x0F).astype(jnp.int8)
+    # sign-extend 4-bit two's complement: v >= 8 -> v - 16
+    lo = jnp.where(lo >= 8, lo - 16, lo)
+    hi = jnp.where(hi >= 8, hi - 16, hi)
+    full = jnp.stack([lo, hi], axis=1).reshape(-1, packed.shape[1])
+    return full[:k]
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", group_size=-1):
+    """y = x @ dequant(weight) + bias with int8/int4 weights (reference:
+    paddle.nn.quant.weight_only_linear).  Dequant happens inside the op
+    so XLA fuses it into the matmul's weight load."""
+    if group_size != -1:
+        raise NotImplementedError(
+            "weight_only_linear: grouped scales are not supported; "
+            "per-output-channel scales only")
+    if weight_scale is None:
+        raise ValueError("weight_only_linear requires weight_scale "
+                         "(from weight_quantize)")
+    xa = _t(x)
+    qa = _t(weight)
+    sa = _t(weight_scale)
+    ba = _t(bias) if bias is not None else None
+    k = xa._array.shape[-1]
+
+    def _impl(xv, qv, sv, *rest):
+        bv = rest[0] if ba is not None else None
+        cdt = xv.dtype
+        if weight_dtype == "int8":
+            wf = qv.astype(cdt) * (sv / 127.0).astype(cdt)[None, :]
+        elif weight_dtype == "int4":
+            wf = _unpack_int4(qv, k).astype(cdt) \
+                * (sv / 7.0).astype(cdt)[None, :]
+        else:
+            raise ValueError(f"weight_dtype {weight_dtype!r}")
+        y = xv @ wf
+        if bv is not None:
+            y = y + bv.astype(cdt)
+        return y
+
+    args = [xa, qa, sa] + ([ba] if ba is not None else [])
+    return engine.apply("weight_only_linear", _impl, args)
+
+
+from . import layer as _layer_mod  # noqa: E402  (after engine import chain)
+
+
+class WeightOnlyLinear(_layer_mod.Layer):
+    """Serving-side Linear with int8/int4 weights (reference:
+    paddle.nn.quant.WeightOnlyLinear).  Build from a trained Linear via
+    ``WeightOnlyLinear.from_linear(lin, algo=...)`` or the module-level
+    ``convert_to_weight_only(model)``."""
+
+    def __init__(self, in_features, out_features, weight_dtype="int8",
+                 bias=True):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.weight_dtype = weight_dtype
+        rows = in_features if weight_dtype == "int8" \
+            else (in_features + 1) // 2
+        # register_buffer (not attribute assignment): the int8 weights
+        # must live in state_dict or checkpoints silently lose them
+        self.register_buffer("quant_weight", Tensor._from_array(
+            jnp.zeros((rows, out_features), jnp.int8)))
+        self.weight_scale = self.create_parameter(
+            [out_features], default_initializer=None)
+        self.weight_scale.stop_gradient = True
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if bias else None
+
+    @classmethod
+    def from_linear(cls, linear, algo="weight_only_int8"):
+        dt = "int8" if algo.endswith("int8") else "int4"
+        inf, outf = linear.weight.shape
+        m = cls(inf, outf, weight_dtype=dt, bias=linear.bias is not None)
+        q, s = weight_quantize(linear.weight, algo=algo)
+        m.quant_weight._inplace_assign(q._array)
+        m.weight_scale._inplace_assign(s._array)
+        if linear.bias is not None:
+            m.bias._inplace_assign(linear.bias._array)
+        return m
+
+    def forward(self, x):
+        return weight_only_linear(x, self.quant_weight, bias=self.bias,
+                                  weight_scale=self.weight_scale,
+                                  weight_dtype=self.weight_dtype)
+
+
+def convert_to_weight_only(model, algo="weight_only_int8",
+                           skip=lambda name, layer: False):
+    """Swap every nn.Linear in ``model`` for a WeightOnlyLinear holding
+    the quantized weights (in place; returns the model).  ``skip(name,
+    layer)`` exempts layers (e.g. lm_head) from conversion."""
+    from .common import Linear
+
+    def _convert(parent, prefix=""):
+        for name, sub in list(parent._sub_layers.items()):
+            full = f"{prefix}.{name}" if prefix else name
+            if isinstance(sub, Linear) and not skip(full, sub):
+                parent._sub_layers[name] = WeightOnlyLinear.from_linear(
+                    sub, algo=algo)
+            else:
+                _convert(sub, full)
+
+    _convert(model)
+    return model

@@ -1,0 +1,120 @@
+"""paddle.nn.quant weight-only quantization (reference:
+python/paddle/nn/quant/quantized_linear.py).
+
+Contracts under test: int8/int4 quantize->linear tracks the fp32 linear
+within quantization error; nibble packing round-trips exactly; a
+converted GPT still generates sensibly with 2x/4x smaller weight bytes.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.nn.quant import (WeightOnlyLinear, convert_to_weight_only,
+                                 weight_only_linear, weight_quantize)
+
+
+class TestWeightQuantize:
+    def test_int8_roundtrip_error_bound(self):
+        rng = np.random.RandomState(0)
+        w = pt.to_tensor(rng.randn(64, 32).astype(np.float32) * 0.1)
+        q, s = weight_quantize(w, algo="weight_only_int8")
+        assert str(q.dtype) == "int8" and q.shape == [64, 32]
+        deq = q.numpy().astype(np.float32) * s.numpy()[None, :] / 127.0
+        err = np.abs(deq - w.numpy()).max()
+        assert err <= s.numpy().max() / 127.0 + 1e-7
+
+    def test_int4_pack_unpack_exact(self):
+        from paddle_tpu.nn.quant import _unpack_int4
+        rng = np.random.RandomState(1)
+        w = pt.to_tensor(rng.randn(31, 8).astype(np.float32))  # odd k
+        q, s = weight_quantize(w, algo="weight_only_int4")
+        assert q.shape == [16, 8]            # ceil(31/2)
+        unpacked = np.asarray(_unpack_int4(q._array, 31))
+        ref = np.clip(np.round(w.numpy() / s.numpy()[None, :] * 7.0),
+                      -7, 7).astype(np.int8)
+        np.testing.assert_array_equal(unpacked, ref)
+
+    def test_weight_only_linear_matches_fp(self):
+        rng = np.random.RandomState(2)
+        x = pt.to_tensor(rng.randn(4, 64).astype(np.float32))
+        w = pt.to_tensor(rng.randn(64, 32).astype(np.float32) * 0.05)
+        b = pt.to_tensor(rng.randn(32).astype(np.float32))
+        ref = (x.numpy() @ w.numpy()) + b.numpy()
+        for algo, rtol in (("weight_only_int8", 2e-2),
+                           ("weight_only_int4", 2e-1)):
+            q, s = weight_quantize(w, algo=algo)
+            y = weight_only_linear(x, q, bias=b, weight_scale=s,
+                                   weight_dtype=algo[-4:])
+            np.testing.assert_allclose(y.numpy(), ref, rtol=rtol,
+                                       atol=rtol)
+
+    def test_weight_only_layer_from_linear(self):
+        pt.seed(3)
+        lin = pt.nn.Linear(16, 8)
+        wol = WeightOnlyLinear.from_linear(lin, algo="weight_only_int8")
+        x = pt.rand([2, 16])
+        np.testing.assert_allclose(wol(x).numpy(), lin(x).numpy(),
+                                   rtol=2e-2, atol=2e-2)
+        # weight bytes shrink 4x vs fp32 storage
+        assert wol.quant_weight.numpy().nbytes * 4 == \
+            lin.weight.numpy().nbytes
+
+    def test_convert_model_and_generate(self):
+        pt.seed(4)
+        from paddle_tpu.text import GPTConfig, GPTForCausalLM
+        from paddle_tpu.text.generation import generate
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=4, max_position_embeddings=32,
+                        hidden_dropout=0.0, attention_dropout=0.0)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        ids = pt.to_tensor(np.arange(8, dtype=np.int64)[None, :] % 64)
+        with pt.no_grad():
+            ref_logits = m(ids).numpy()
+        n_lin_before = sum(isinstance(l, pt.nn.Linear)
+                           for l in m.sublayers())
+        convert_to_weight_only(m, algo="weight_only_int8")
+        n_lin_after = sum(isinstance(l, pt.nn.Linear)
+                          for l in m.sublayers())
+        n_wol = sum(isinstance(l, WeightOnlyLinear) for l in m.sublayers())
+        assert n_wol == n_lin_before and n_lin_after == 0
+        with pt.no_grad():
+            q_logits = m(ids).numpy()
+        # quantization error stays small relative to logit scale
+        denom = np.abs(ref_logits).max()
+        assert np.abs(q_logits - ref_logits).max() / denom < 0.1
+        out = generate(m, ids, max_new_tokens=4)
+        assert out.shape == [1, 12]
+
+    def test_state_dict_roundtrip_preserves_quant_weights(self):
+        # regression: quant_weight must be a registered buffer or
+        # checkpoints silently drop the int8 weights
+        pt.seed(5)
+        lin = pt.nn.Linear(8, 4)
+        wol = WeightOnlyLinear.from_linear(lin)
+        sd = wol.state_dict()
+        assert any("quant_weight" in k for k in sd)
+        fresh = WeightOnlyLinear(8, 4)
+        missing, unexpected = fresh.set_state_dict(sd)
+        assert not missing and not unexpected
+        x = pt.rand([2, 8])
+        np.testing.assert_allclose(fresh(x).numpy(), wol(x).numpy(),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_scale_required(self):
+        q, s = weight_quantize(pt.rand([8, 4]))
+        with pytest.raises(ValueError, match="weight_scale"):
+            weight_only_linear(pt.rand([2, 8]), q)
+
+    def test_skip_predicate(self):
+        m = pt.nn.Sequential(pt.nn.Linear(4, 4), pt.nn.Linear(4, 4))
+        convert_to_weight_only(m, skip=lambda name, l: name.endswith("1"))
+        kinds = [type(l).__name__ for l in m]
+        assert kinds == ["WeightOnlyLinear", "Linear"]
+
+    def test_grouped_scales_raise(self):
+        w = pt.rand([8, 4])
+        q, s = weight_quantize(w)
+        with pytest.raises(NotImplementedError, match="group"):
+            weight_only_linear(pt.rand([2, 8]), q, weight_scale=s,
+                               group_size=64)

@@ -40,6 +40,7 @@ def _namespaces(pt):
         ("paddle", pt), ("paddle.nn", pt.nn),
         ("paddle.nn.functional", pt.nn.functional),
         ("paddle.nn.initializer", pt.nn.initializer),
+        ("paddle.nn.quant", pt.nn.quant),
         ("paddle.optimizer", pt.optimizer),
         ("paddle.optimizer.lr", pt.optimizer.lr),
         ("paddle.distributed", pt.distributed),
