@@ -1,0 +1,79 @@
+"""Weight-only int8/int4 LLM serving (reference workflow: PaddleNLP
+weight-only inference — paddle.nn.quant.weight_quantize + predictor).
+
+Train (or load) an fp32 GPT, convert every Linear to int8/int4
+weight-only, checkpoint, reload, and serve with the jitted KV-cache
+decoder.  On TPU the dequant (w.astype(bf16) * scale) fuses into the
+matmul's weight load, so decode HBM traffic — the serving bottleneck —
+drops 2x/4x with bf16 MXU math.
+
+    python examples/serve_weight_only.py --cpu --algo weight_only_int8
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--algo", default="weight_only_int8",
+                    choices=["weight_only_int8", "weight_only_int4"])
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--new-tokens", type=int, default=16)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import paddle
+    from paddle.nn.quant import convert_to_weight_only
+    from paddle.text import GPTConfig, GPTForCausalLM, gpt_loss_fn
+    from paddle.text.decode import jit_generate
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=args.hidden,
+                    num_layers=args.layers, num_heads=4,
+                    max_position_embeddings=128, hidden_dropout=0.0,
+                    attention_dropout=0.0)
+    with paddle.LazyGuard():
+        model = GPTForCausalLM(cfg)
+
+    # 1. brief training so generation has signal
+    opt = paddle.optimizer.AdamW(learning_rate=3e-3,
+                                 parameters=model.parameters())
+    step = paddle.jit.train_step(model, gpt_loss_fn, opt)
+    ids = paddle.randint(0, 256, [8, 32])
+    for i in range(args.steps):
+        loss = step(ids, ids)
+    print(f"trained {args.steps} steps, loss {float(loss):.3f}")
+
+    # 2. convert + checkpoint (GPT ties its output head to the token
+    # embedding, so every Linear here is safe to quantize; pass skip=...
+    # to exempt layers on models with a separate head)
+    fp_bytes = sum(p.numpy().nbytes for p in model.parameters())
+    convert_to_weight_only(model, algo=args.algo)
+    q_bytes = sum(v.numpy().nbytes for v in model.state_dict().values())
+    print(f"weights: {fp_bytes/1e6:.1f}MB fp32 -> "
+          f"{q_bytes/1e6:.1f}MB {args.algo}")
+    paddle.save(model.state_dict(), "/tmp/wo_serve.pdparams")
+
+    # 3. reload into a fresh converted skeleton and serve
+    served = GPTForCausalLM(cfg)
+    convert_to_weight_only(served, algo=args.algo)
+    served.set_state_dict(paddle.load("/tmp/wo_serve.pdparams"))
+    served.eval()
+    prompt = paddle.to_tensor(
+        np.arange(16, dtype=np.int64)[None, :] % 256)
+    out = jit_generate(served, prompt, max_new_tokens=args.new_tokens)
+    print("generated ids:", out.numpy()[0, -args.new_tokens:].tolist())
+
+
+if __name__ == "__main__":
+    main()

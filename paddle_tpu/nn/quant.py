@@ -23,7 +23,8 @@ def _absmax_scale(w, axis):
     return jnp.where(s == 0, 1.0, s)
 
 
-def weight_quantize(x, algo="weight_only_int8"):
+def weight_quantize(x, algo="weight_only_int8", arch=None,
+                    group_size=-1):
     """Quantize a [in, out] weight matrix for weight-only inference.
 
     Returns (quantized_weight, scale) Tensors:
@@ -31,6 +32,11 @@ def weight_quantize(x, algo="weight_only_int8"):
       * int4: two values packed per int8 byte along the IN axis
         (out[ceil(k/2), n]), scale[n] fp32 — w ≈ nibble * scale / 7
     """
+    if group_size != -1:
+        raise NotImplementedError(
+            "weight_quantize: grouped scales are not supported; "
+            "per-output-channel scales only")
+    # arch is a CUDA SM hint in the reference; meaningless on TPU
     w = _t(x)._array.astype(jnp.float32)
     if w.ndim != 2:
         raise ValueError(f"weight_quantize expects 2-D weights, got "
@@ -115,6 +121,10 @@ class WeightOnlyLinear(_layer_mod.Layer):
     def __init__(self, in_features, out_features, weight_dtype="int8",
                  bias=True):
         super().__init__()
+        if weight_dtype not in ("int8", "int4"):
+            raise ValueError(
+                f"WeightOnlyLinear weight_dtype must be 'int8' or "
+                f"'int4', got {weight_dtype!r}")
         self.in_features = in_features
         self.out_features = out_features
         self.weight_dtype = weight_dtype
