@@ -50,9 +50,11 @@ def main():
                                  parameters=model.parameters())
     step = paddle.jit.train_step(model, gpt_loss_fn, opt)
     ids = paddle.randint(0, 256, [8, 32])
+    loss = None
     for i in range(args.steps):
         loss = step(ids, ids)
-    print(f"trained {args.steps} steps, loss {float(loss):.3f}")
+    if loss is not None:
+        print(f"trained {args.steps} steps, loss {float(loss):.3f}")
 
     # 2. convert + checkpoint (GPT ties its output head to the token
     # embedding, so every Linear here is safe to quantize; pass skip=...

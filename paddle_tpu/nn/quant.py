@@ -90,7 +90,7 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     ba = _t(bias) if bias is not None else None
     k = xa._array.shape[-1]
 
-    def _impl(xv, qv, sv, *rest):
+    def _impl(xv, qv, sv, *rest, weight_dtype=weight_dtype, k=k):
         bv = rest[0] if ba is not None else None
         cdt = xv.dtype
         if weight_dtype == "int8":
@@ -106,7 +106,10 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
         return y
 
     args = [xa, qa, sa] + ([ba] if ba is not None else [])
-    return engine.apply("weight_only_linear", _impl, args)
+    # weight_dtype/k ride in consts so graph capture (onnx export) can
+    # emit DequantizeLinear with the right unpacking
+    return engine.apply("weight_only_linear", _impl, args,
+                        {"weight_dtype": weight_dtype, "k": k})
 
 
 from . import layer as _layer_mod  # noqa: E402  (after engine import chain)

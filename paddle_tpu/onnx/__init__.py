@@ -594,8 +594,38 @@ def _e_where(ctx, ins, consts, outs, arrs):
     ctx.node("Where", ins, outs)
 
 
+def _e_weight_only_linear(ctx, ins, consts, outs, arrs):
+    """WeightOnlyLinear (nn/quant.py): DequantizeLinear + MatMul.  int4
+    weights are unpacked host-side into the int8 initializer (ONNX has no
+    nibble packing); the per-output-channel scale folds the /127 (or /7)
+    divisor."""
+    from ..nn.quant import _unpack_int4
+    scale = _np(arrs[2])
+    wdt = consts["weight_dtype"]
+    if wdt == "int4":
+        # unpacked into a fresh int8 initializer (ONNX has no nibble
+        # packing); the packed original is pruned by the dead-initializer
+        # sweep at the end of export()
+        q = _np(_unpack_int4(arrs[1], consts["k"]))
+        qname = ctx.name_of(q.astype(np.int8), "quant_w")
+        div = 7.0
+    else:
+        qname = ctx.name_of(arrs[1], "quant_w")  # reuse the traced array
+        div = 127.0
+    sname = ctx.name_of((scale / div).astype(np.float32), "w_scale")
+    deq = ctx.fresh("deq_w")
+    ctx.node("DequantizeLinear", [qname, sname], [deq], axis=1)
+    if len(ins) > 3:   # bias
+        mm = ctx.fresh("wo_mm")
+        ctx.node("MatMul", [ins[0], deq], [mm])
+        ctx.node("Add", [mm, ins[3]], outs)
+    else:
+        ctx.node("MatMul", [ins[0], deq], outs)
+
+
 _EMIT = {
     "matmul": _e_matmul,
+    "weight_only_linear": _e_weight_only_linear,
     "unbind": _e_unbind,
     "rms_norm": _e_rms_norm,
     "silu": _e_silu,
@@ -742,6 +772,16 @@ def export(layer, path, input_spec=None, opset_version=17, **configs):
         final = f"output_{i}"
         ctx.node("Identity", [nm], [final])
         g.output.append(_value_info(final, list(t.shape), str(t.dtype)))
+
+    # dead-initializer sweep: emitters may re-materialize a traced array
+    # under a new name (int4 unpack, folded scales) — unreferenced
+    # initializers would otherwise bloat the file (e.g. double-storing
+    # every quantized weight)
+    referenced = {i for n in g.node for i in n.input}
+    live = [t for t in g.initializer if t.name in referenced]
+    if len(live) != len(g.initializer):
+        del g.initializer[:]
+        g.initializer.extend(live)
 
     out_path = path if path.endswith(".onnx") else path + ".onnx"
     os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)

@@ -236,6 +236,15 @@ def evaluate(model, inputs):
             r = -ins[0]
         elif op == "Tile":
             r = np.tile(ins[0], [int(x) for x in ins[1]])
+        elif op == "DequantizeLinear":
+            ax = int(at.get("axis", 1))
+            sc = ins[1]
+            shape = [1] * ins[0].ndim
+            shape[ax] = -1
+            xq = ins[0].astype(np.float32)
+            if len(ins) > 2 and ins[2] is not None:   # zero point FIRST
+                xq = xq - ins[2].reshape(shape).astype(np.float32)
+            r = xq * sc.reshape(shape)
         elif op == "Where":
             r = np.where(ins[0], ins[1], ins[2])
         elif op == "Split":

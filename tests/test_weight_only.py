@@ -112,6 +112,33 @@ class TestWeightQuantize:
         kinds = [type(l).__name__ for l in m]
         assert kinds == ["WeightOnlyLinear", "Linear"]
 
+    @pytest.mark.parametrize("algo", ["weight_only_int8",
+                                      "weight_only_int4"])
+    def test_onnx_export_of_converted_model(self, tmp_path, algo):
+        # a weight-only model serializes as DequantizeLinear + MatMul and
+        # round-trips through the bundled evaluator (int4 unpacks into
+        # the int8 initializer — ONNX has no nibble packing)
+        from paddle_tpu import onnx as ponnx
+        pt.seed(6)
+        m = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.ReLU(),
+                             pt.nn.Linear(16, 4))
+        convert_to_weight_only(m, algo=algo)
+        m.eval()
+        x = pt.rand([3, 8])
+        with pt.no_grad():
+            want = m(x).numpy()
+        p = ponnx.export(m, str(tmp_path / "wo"), input_spec=[x])
+        model = ponnx.load(p)
+        assert any(n.op_type == "DequantizeLinear"
+                   for n in model.graph.node)
+        # dead-initializer sweep: every initializer must be referenced
+        # (no double-stored quantized weights)
+        referenced = {i for n in model.graph.node for i in n.input}
+        for t in model.graph.initializer:
+            assert t.name in referenced, t.name
+        got = ponnx.run(model, [x.numpy()])[0]
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
     def test_grouped_scales_raise(self):
         w = pt.rand([8, 4])
         q, s = weight_quantize(w)
