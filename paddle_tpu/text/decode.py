@@ -21,6 +21,17 @@ from ..jit import functional_bridge as FB
 from ..tensor import Tensor
 
 
+def _lru_compiled(store, key, build, cap=8):
+    """Pop-reinsert LRU over a dict of compiled programs."""
+    fn = store.pop(key, None)
+    if fn is None:
+        fn = build()
+    store[key] = fn
+    while len(store) > cap:
+        store.pop(next(iter(store)))
+    return fn
+
+
 def _update_prealloc_cache(cache, k, v, s):
     """Write k/v at cache['pos'] and return full buffers + bool attn mask."""
     from .. import tensor_api as T
@@ -77,8 +88,8 @@ def jit_generate(model, input_ids, max_new_tokens=20, do_sample=False,
         cache_key = (prompt_len, max_new_tokens, bool(do_sample),
                      float(temperature), top_k, top_p, eos_token_id, b)
         cache = model.__dict__.setdefault("_jit_decode_cache", {})
-        fn = cache.pop(cache_key, None)  # re-insert below → LRU order
-        if fn is None:
+
+        def _build():
             def pure(p_arrays, b_arrays, ids, cache_arrays, key):
                 ids = ids.astype(jnp.int32)
                 logits, cache_arrays = _model_step(
@@ -123,11 +134,9 @@ def jit_generate(model, input_ids, max_new_tokens=20, do_sample=False,
                 _, buf, _, _, _ = lax.while_loop(cond, body, state)
                 return buf
 
-            fn = jax.jit(pure)
-        cache[cache_key] = fn
-        while len(cache) > 8:  # LRU: varying prompt shapes would otherwise
-            cache.pop(next(iter(cache)))  # retain every compiled program
+            return jax.jit(pure)
 
+        fn = _lru_compiled(cache, cache_key, _build)
         out = fn(p_arrays, b_arrays, input_ids._array, cache_arrays, key)
         if eos_token_id is not None:
             # match the eager loop's early-exit shape: truncate after the
@@ -142,3 +151,123 @@ def jit_generate(model, input_ids, max_new_tokens=20, do_sample=False,
     finally:
         if was_training:
             model.train()
+
+
+def speculative_generate(model, draft_model, input_ids, max_new_tokens=20,
+                         num_speculative_tokens=4):
+    """Greedy speculative decoding (reference analog: PaddleNLP's
+    speculative/draft-model inference; Leviathan et al. 2023 with
+    exact-match acceptance).
+
+    The draft model proposes ``num_speculative_tokens`` tokens per round;
+    ONE multi-token target forward verifies them (the preallocated-cache
+    step already builds the correct [s, L] causal mask at any position,
+    _update_prealloc_cache), the longest matching prefix is accepted, and
+    the target's own argmax supplies the correction token.  Because
+    acceptance is exact-match against the target's greedy choice, the
+    output is IDENTICAL to ``jit_generate(model, ..., do_sample=False)``
+    — the draft only changes how many target forwards are needed.
+
+    TPU-native: the ENTIRE loop (draft scan + verify + acceptance) is one
+    jitted lax.while_loop program — no host round-trips per round; cache
+    "rewind" after rejection is free (stale entries sit beyond the pos
+    scalar, masked out and later overwritten).
+
+    Batch 1 only (rows would diverge in acceptance length).
+    """
+    k = int(num_speculative_tokens)
+    if k < 2:
+        raise ValueError("num_speculative_tokens must be >= 2")
+    b, prompt_len = input_ids.shape
+    if b != 1:
+        raise NotImplementedError(
+            "speculative_generate supports batch 1 (acceptance length "
+            "diverges per row)")
+    total = prompt_len + max_new_tokens
+
+    was_t, was_d = model.training, draft_model.training
+    model.eval()
+    draft_model.eval()
+    try:
+        pn_t, p_t, bn_t, b_t = FB.split_state(model)
+        pn_d, p_d, bn_d, b_d = FB.split_state(draft_model)
+        proto_t = model.new_caches(b, dtype=p_t[0].dtype,
+                                   max_length=total + k + 1)
+        proto_d = draft_model.new_caches(b, dtype=p_d[0].dtype,
+                                         max_length=total + k + 1)
+        cache_t = [(c["k"]._array, c["v"]._array) for c in proto_t]
+        cache_d = [(c["k"]._array, c["v"]._array) for c in proto_d]
+
+        # the compiled program closes over BOTH modules' structures, so
+        # the draft's identity must key the cache too
+        ckey = (prompt_len, max_new_tokens, k, id(draft_model))
+        jcache = model.__dict__.setdefault("_spec_decode_cache", {})
+
+        def _build():
+            def pure(p_t_, b_t_, p_d_, b_d_, ids, cache_t, cache_d):
+                ids = ids.astype(jnp.int32)
+                zero = jnp.asarray(0, jnp.int32)
+                t_lg, cache_t = _model_step(model, pn_t, bn_t, p_t_, b_t_,
+                                            ids, cache_t, zero)
+                _, cache_d = _model_step(draft_model, pn_d, bn_d, p_d_,
+                                         b_d_, ids, cache_d, zero)
+                cur = jnp.argmax(t_lg[0, -1, :]).astype(jnp.int32)
+                buf = jnp.zeros((total + k + 1,), jnp.int32)
+                buf = lax.dynamic_update_slice(buf, ids[0], (0,))
+                buf = buf.at[prompt_len].set(cur)
+                n = jnp.asarray(1, jnp.int32)
+                pos = jnp.asarray(prompt_len, jnp.int32)
+
+                def cond(state):
+                    return state[0] < max_new_tokens
+
+                def body(state):
+                    n, buf, cur, pos, cache_t, cache_d = state
+
+                    def dstep(carry, _):
+                        tok, cd, dpos = carry
+                        lg, cd = _model_step(
+                            draft_model, pn_d, bn_d, p_d_, b_d_,
+                            tok[None, None], cd, dpos)
+                        nxt = jnp.argmax(lg[0, -1, :]).astype(jnp.int32)
+                        return (nxt, cd, dpos + 1), nxt
+
+                    # k+1 draft steps: the last one's PROPOSAL is unused,
+                    # but its cache write stores d_k's kv — without it a
+                    # fully-accepted round leaves a hole at pos+k that
+                    # would silently degrade later draft proposals
+                    (_, cache_d, _), props_all = lax.scan(
+                        dstep, (cur, cache_d, pos), None, length=k + 1)
+                    props = props_all[:k]
+                    # verify [cur, d1..dk] (k+1 rows) in ONE target
+                    # forward so every paid-for proposal is checked;
+                    # logits[j] chooses the token at index pos+j+1
+                    verify = jnp.concatenate([cur[None], props])[None, :]
+                    t_lg, cache_t = _model_step(
+                        model, pn_t, bn_t, p_t_, b_t_, verify, cache_t,
+                        pos)
+                    greedy = jnp.argmax(t_lg[0], axis=-1).astype(jnp.int32)
+                    eq = (props == greedy[:k]).astype(jnp.int32)
+                    m = jnp.sum(jnp.cumprod(eq))        # accepted: 0..k
+                    emit = m + 1                        # + correction/bonus
+                    # write all k candidates; rounds overwrite beyond emit
+                    buf = lax.dynamic_update_slice(buf, greedy,
+                                                   (prompt_len + n,))
+                    return (n + emit, buf, greedy[m], pos + emit,
+                            cache_t, cache_d)
+
+                state = (n, buf, cur, pos, cache_t, cache_d)
+                n, buf, cur, pos, cache_t, cache_d = lax.while_loop(
+                    cond, body, state)
+                return buf[:total][None, :]
+
+            return jax.jit(pure)
+
+        fn = _lru_compiled(jcache, ckey, _build)
+        out = fn(p_t, b_t, p_d, b_d, input_ids._array, cache_t, cache_d)
+        return Tensor._from_array(out)
+    finally:
+        if was_t:
+            model.train()
+        if was_d:
+            draft_model.train()

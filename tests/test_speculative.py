@@ -1,0 +1,78 @@
+"""Speculative decoding (text/decode.py speculative_generate).
+
+The load-bearing property: exact-match acceptance makes the output
+IDENTICAL to the target model's greedy decode, for ANY draft — a bad
+draft only lowers the acceptance rate, never changes tokens.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.text import GPTConfig, GPTForCausalLM
+from paddle_tpu.text.decode import jit_generate, speculative_generate
+
+
+def _model(layers, hidden, seed):
+    pt.seed(seed)
+    cfg = GPTConfig(vocab_size=96, hidden_size=hidden, num_layers=layers,
+                    num_heads=4, max_position_embeddings=96,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def target():
+    return _model(3, 48, 11)
+
+
+@pytest.fixture(scope="module")
+def prompt():
+    return pt.to_tensor(
+        np.array([[5, 17, 40, 3, 88, 2, 64, 9]], np.int64))
+
+
+class TestSpeculative:
+    def test_matches_greedy_with_weak_draft(self, target, prompt):
+        draft = _model(1, 16, 99)   # unrelated weights: low acceptance
+        want = jit_generate(target, prompt, max_new_tokens=16).numpy()
+        got = speculative_generate(target, draft, prompt,
+                                   max_new_tokens=16,
+                                   num_speculative_tokens=4).numpy()
+        np.testing.assert_array_equal(got, want)
+
+    def test_matches_greedy_with_perfect_draft(self, target, prompt):
+        # draft == target: every proposal accepted, still identical
+        want = jit_generate(target, prompt, max_new_tokens=12).numpy()
+        got = speculative_generate(target, target, prompt,
+                                   max_new_tokens=12,
+                                   num_speculative_tokens=3).numpy()
+        np.testing.assert_array_equal(got, want)
+
+    def test_various_k(self, target, prompt):
+        draft = _model(1, 16, 7)
+        want = jit_generate(target, prompt, max_new_tokens=10).numpy()
+        for k in (2, 5):
+            got = speculative_generate(target, draft, prompt,
+                                       max_new_tokens=10,
+                                       num_speculative_tokens=k).numpy()
+            np.testing.assert_array_equal(got, want)
+
+    def test_draft_swap_recompiles(self, target, prompt):
+        # the compiled program closes over the draft's structure: swapping
+        # to a draft with a DIFFERENT architecture must not reuse it
+        want = jit_generate(target, prompt, max_new_tokens=8).numpy()
+        d1 = _model(1, 16, 21)
+        d2 = _model(2, 32, 22)   # different layer count + width
+        for d in (d1, d2, d1):
+            got = speculative_generate(target, d, prompt,
+                                       max_new_tokens=8,
+                                       num_speculative_tokens=3).numpy()
+            np.testing.assert_array_equal(got, want)
+
+    def test_batch_gt1_raises(self, target):
+        ids = pt.to_tensor(np.zeros((2, 4), np.int64))
+        draft = _model(1, 16, 7)
+        with pytest.raises(NotImplementedError, match="batch 1"):
+            speculative_generate(target, draft, ids)
