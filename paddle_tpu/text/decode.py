@@ -176,8 +176,8 @@ def speculative_generate(model, draft_model, input_ids, max_new_tokens=20,
     Batch 1 only (rows would diverge in acceptance length).
     """
     k = int(num_speculative_tokens)
-    if k < 2:
-        raise ValueError("num_speculative_tokens must be >= 2")
+    if k < 1:
+        raise ValueError("num_speculative_tokens must be >= 1")
     b, prompt_len = input_ids.shape
     if b != 1:
         raise NotImplementedError(

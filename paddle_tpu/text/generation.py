@@ -36,8 +36,25 @@ def _sample_next(logits, temperature, top_k, top_p, greedy):
 
 
 def generate(model, input_ids, max_new_tokens=20, do_sample=False,
-             temperature=1.0, top_k=None, top_p=None, eos_token_id=None):
-    """Returns Tensor [b, prompt + new] of token ids."""
+             temperature=1.0, top_k=None, top_p=None, eos_token_id=None,
+             draft_model=None, num_speculative_tokens=4):
+    """Returns Tensor [b, prompt + new] of token ids.  Passing
+    ``draft_model`` routes greedy decoding through speculative decoding
+    (decode.speculative_generate — token-identical output, fewer target
+    forwards)."""
+    if draft_model is not None:
+        if do_sample:
+            raise NotImplementedError(
+                "speculative decoding is greedy-only (exact-match "
+                "acceptance); drop draft_model or do_sample")
+        if eos_token_id is not None:
+            raise NotImplementedError(
+                "speculative decoding does not trim at eos_token_id yet")
+        from .decode import speculative_generate
+        # both paths yield int32 ids (Tensor wrapping canonicalizes 64-bit)
+        return speculative_generate(
+            model, draft_model, input_ids, max_new_tokens=max_new_tokens,
+            num_speculative_tokens=num_speculative_tokens)
     was_training = model.training
     model.eval()
     try:

@@ -53,7 +53,7 @@ class TestSpeculative:
     def test_various_k(self, target, prompt):
         draft = _model(1, 16, 7)
         want = jit_generate(target, prompt, max_new_tokens=10).numpy()
-        for k in (2, 5):
+        for k in (1, 2, 5):
             got = speculative_generate(target, draft, prompt,
                                        max_new_tokens=10,
                                        num_speculative_tokens=k).numpy()
@@ -70,6 +70,18 @@ class TestSpeculative:
                                        max_new_tokens=8,
                                        num_speculative_tokens=3).numpy()
             np.testing.assert_array_equal(got, want)
+
+    def test_generate_api_routes_draft_model(self, target, prompt):
+        from paddle_tpu.text.generation import generate
+        draft = _model(1, 16, 31)
+        want = jit_generate(target, prompt, max_new_tokens=6).numpy()
+        got_t = generate(target, prompt, max_new_tokens=6,
+                         draft_model=draft)
+        plain = generate(target, prompt, max_new_tokens=6)
+        assert str(got_t.dtype) == str(plain.dtype)   # path-consistent ids
+        np.testing.assert_array_equal(got_t.numpy(), want)
+        with pytest.raises(NotImplementedError, match="greedy-only"):
+            generate(target, prompt, draft_model=draft, do_sample=True)
 
     def test_batch_gt1_raises(self, target):
         ids = pt.to_tensor(np.zeros((2, 4), np.int64))
