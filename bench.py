@@ -549,6 +549,11 @@ def _archive(record):
     """Persist corroborating evidence (loss series, per-step times, device
     string) from every successful chip run into bench_results/ so an
     archived headline is auditable (VERDICT r2 item 1)."""
+    if (os.environ.get("BENCH_SKIP_PROBE") == "1"
+            or os.environ.get("BENCH_FORCE_CPU") == "1"):
+        _log("# smoke mode: NOT archiving (bench_results/ holds only "
+             "real-chip evidence)")
+        return
     try:
         d = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "bench_results")
@@ -604,55 +609,75 @@ def main():
     headline = None
     record = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
               "legs": {}}
-    if not _probe_with_retry_window():
+    if os.environ.get("BENCH_SKIP_PROBE") == "1":
+        _log("# BENCH_SKIP_PROBE=1: ladder smoke mode (no chip probe)")
+    elif not _probe_with_retry_window():
         return   # zero-value headline already on stdout (fail-open)
 
-    # ---- headline: GPT ladder, largest preset that fits
-    preset_plan = [
-        (os.environ.get("BENCH_PRESET", "gpt3-1.3B"),
-         int(os.environ.get("BENCH_SEQ", "1024")),
-         int(os.environ.get("BENCH_BATCH", "4"))),
-        ("gpt3-760M", 1024, 4),
-        ("gpt3-350M", 1024, 8),
-        ("gpt3-125M", 1024, 8),
-    ]
-    for preset, seq, batch in preset_plan:
-        if _left() < 300:
-            _log("# gpt ladder: out of budget")
+    # ---- headline: GPT ladder, SMALLEST first (VERDICT r4 item 1).
+    # A brief claim window must bank a nonzero measured number: the 125M
+    # preset compiles+measures in minutes, so run it first, emit its
+    # headline IMMEDIATELY, then climb and re-emit upgrades (larger
+    # presets score higher vs_baseline; the driver parses the last JSON
+    # line, and SIGTERM re-emits _BEST, so an upgrade can never be lost
+    # and a wedged larger leg can never erase the banked number).
+    top = (os.environ.get("BENCH_PRESET", "gpt3-1.3B"),
+           int(os.environ.get("BENCH_SEQ", "1024")),
+           int(os.environ.get("BENCH_BATCH", "4")))
+    ladder = [("gpt3-125M", 1024, 8), ("gpt3-350M", 1024, 8),
+              ("gpt3-760M", 1024, 4)]
+    names = [p for p, _, _ in ladder]
+    if top[0] in names:   # env preset caps the climb (by name: seq/batch
+        ladder = ladder[:names.index(top[0])] + [top]   # overrides honored)
+    else:
+        ladder.append(top)
+    try:   # smoke hook: extra run_gpt kwargs (tiny steps / cfg overrides)
+        gpt_kw = json.loads(os.environ.get("BENCH_GPT_KW", "{}"))
+    except ValueError as e:   # fail open, not with a dead stdout
+        _log(f"# BENCH_GPT_KW unparseable ({e}); ignoring")
+        gpt_kw = {}
+    for preset, seq, batch in ladder:
+        # first rung needs only its own slack; climbing requires enough
+        # left that a timeout can't eat the secondary legs' budget too
+        if _left() < (300 if headline is None else 700):
+            _log(f"# gpt ladder: out of budget before {preset}")
             break
         res = _spawn({"kind": "gpt", "preset": preset, "seq_len": seq,
-                      "batch": batch}, min(PRESET_TIMEOUT, _left()))
-        if res:
-            n_params = res["n_params"]
-            tps = res["tps"]
-            mfu = 6.0 * n_params * tps / (PEAK_TFLOPS * 1e12)
-            headline = {
-                "metric": f"GPT({preset}, seq{seq}) train tokens/sec/chip",
-                "value": round(tps, 1),
-                "unit": "tokens/s/chip",
-                # honest bar: derived A100-class tok/s at 50% MFU (see top)
-                "vs_baseline": round(tps / _gpt_baseline_tps(n_params), 3),
-                "mfu": round(mfu, 4),
-            }
-            record["legs"]["gpt"] = {**res, "preset": preset,
-                                     "mfu": round(mfu, 4)}
-            _log(f"# gpt {preset}: params={n_params/1e9:.2f}B "
-                 f"loss={res['loss']:.3f} batch={batch} seq={seq} "
-                 f"tokens/s={tps:.1f} MFU={mfu*100:.1f}% "
-                 f"(peak {PEAK_TFLOPS:.0f} TFLOPs bf16; baseline "
-                 f"{_gpt_baseline_tps(n_params):.0f} tok/s = A100 "
-                 f"{A100_PEAK_TFLOPS:.0f}T x {A100_ASSUMED_MFU:.0%} MFU)")
-            break
+                      "batch": batch, **gpt_kw},
+                     min(PRESET_TIMEOUT, _left()))
+        if not res:
+            continue
+        n_params = res["n_params"]
+        tps = res["tps"]
+        mfu = 6.0 * n_params * tps / (PEAK_TFLOPS * 1e12)
+        cand = {
+            "metric": f"GPT({preset}, seq{seq}) train tokens/sec/chip",
+            "value": round(tps, 1),
+            "unit": "tokens/s/chip",
+            # honest bar: derived A100-class tok/s at 50% MFU (see top)
+            "vs_baseline": round(tps / _gpt_baseline_tps(n_params), 3),
+            "mfu": round(mfu, 4),
+        }
+        record["legs"][f"gpt:{preset}"] = {**res, "preset": preset,
+                                           "mfu": round(mfu, 4)}
+        _log(f"# gpt {preset}: params={n_params/1e9:.2f}B "
+             f"loss={res['loss']:.3f} batch={batch} seq={seq} "
+             f"tokens/s={tps:.1f} MFU={mfu*100:.1f}% "
+             f"(peak {PEAK_TFLOPS:.0f} TFLOPs bf16; baseline "
+             f"{_gpt_baseline_tps(n_params):.0f} tok/s = A100 "
+             f"{A100_PEAK_TFLOPS:.0f}T x {A100_ASSUMED_MFU:.0%} MFU)")
+        if headline is None or cand["vs_baseline"] >= headline["vs_baseline"]:
+            headline = cand
+            _emit(headline)              # bank/upgrade NOW
+            record["headline"] = headline
+            _archive(record)             # evidence survives a later wedge
     if headline is None:
-        headline = {"metric": "GPT train tokens/sec/chip", "value": 0.0,
-                    "unit": "tokens/s/chip", "vs_baseline": 0.0,
-                    "error": "all GPT presets failed/timed out "
-                             "(probe was OK; see stderr)"}
-    # print the headline BEFORE the secondary legs so an external kill
-    # mid-resnet/llama can't lose the measured number (round-1 rc=124)
-    _emit(headline)
-    record["headline"] = headline
-    _archive(record)   # evidence survives even if a later leg wedges
+        # keep the last_measured evidence pointer on the failure path too
+        headline = _stale_headline("all GPT presets failed/timed out "
+                                   "(probe was OK; see stderr)")
+        _emit(headline)
+        record["headline"] = headline
+        _archive(record)
 
     # ---- secondary legs (stderr json so the driver tail records them)
     if _left() > 400:

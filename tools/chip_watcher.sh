@@ -1,0 +1,66 @@
+#!/bin/bash
+# Relaunch bench.py across TPU claim windows so a brief grant is never
+# missed (VERDICT r4 item 1).  Each bench invocation owns a fresh
+# BENCH_TOTAL_BUDGET window and fail-opens on its own; after the first
+# MEASURED run this script drains the on-chip tuning queue (item 2):
+# pallas block tuner -> ring bench -> then keeps re-benching to upgrade
+# the ladder headline.
+#
+#   nohup bash tools/chip_watcher.sh > /tmp/watcher.log 2>&1 &
+#
+# KILL THIS (and any bench.py children) BEFORE SESSION END — a live bench
+# would hold the TPU claim against the driver's official capture.
+set -u
+cd "$(dirname "$0")/.."
+STAMP=$(date +%s)
+DRAINED=0
+
+measured_since_start() {
+    python - "$STAMP" <<'EOF'
+import glob, json, os, sys
+stamp = float(sys.argv[1])
+for rec in glob.glob('bench_results/run_*.json'):
+    if os.path.getmtime(rec) < stamp:
+        continue
+    try:
+        h = json.load(open(rec)).get('headline') or {}
+    except Exception:
+        continue
+    if h.get('value'):
+        sys.exit(0)
+sys.exit(1)
+EOF
+}
+
+round=0
+while true; do
+    round=$((round + 1))
+    echo "== watcher round $round $(date -u +%H:%M:%SZ): bench.py"
+    BENCH_TOTAL_BUDGET="${WATCH_BENCH_BUDGET:-3300}" \
+        python bench.py >> /tmp/watch_bench.out 2>> /tmp/watch_bench.err
+    echo "== bench exited rc=$? $(date -u +%H:%M:%SZ)"
+    if measured_since_start; then
+        echo "== MEASURED run banked (bench_results/ has a fresh nonzero headline)"
+        if [ "$DRAINED" -eq 0 ]; then
+            echo "== draining on-chip queue: pallas_tune --quick --write"
+            timeout 2400 python tools/pallas_tune.py --quick --write \
+                >> /tmp/watch_tune.out 2>&1
+            tune_rc=$?
+            echo "== pallas_tune rc=$tune_rc"
+            echo "== draining on-chip queue: ring_bench --chip"
+            timeout 1800 python tools/ring_bench.py --chip \
+                >> /tmp/watch_ring.out 2>&1
+            ring_rc=$?
+            echo "== ring_bench rc=$ring_rc"
+            # only mark drained when both succeeded — a claim drop
+            # mid-drain must retry on the next measured window
+            if [ "$tune_rc" -eq 0 ] && [ "$ring_rc" -eq 0 ]; then
+                DRAINED=1
+            fi
+        fi
+        # keep climbing: another full bench may upgrade the ladder rung
+        sleep 60
+    else
+        sleep 30   # bench's own probe loop already paced the claim polls
+    fi
+done
