@@ -460,9 +460,17 @@ register("normal_k", lambda key, shape, dtype, mean=0.0, std=1.0:
 def dyn_update_seq_k(buf, val, pos):
     """Write `val` into `buf` at sequence offset `pos` (axis 1) — the
     preallocated KV-cache update used by the jitted decode loop
-    (reference analog: paddle's fused write_cache_kv in inference)."""
-    return jax.lax.dynamic_update_slice_in_dim(
-        buf, val.astype(buf.dtype), pos.astype(jnp.int32), axis=1)
+    (reference analog: paddle's fused write_cache_kv in inference).
+    `pos` may be a scalar (all rows share the offset) or a [b] vector
+    (per-row offsets — batched speculative decoding, where rows accept
+    different numbers of draft tokens per round)."""
+    pos = pos.astype(jnp.int32)
+    if pos.ndim == 0:
+        return jax.lax.dynamic_update_slice_in_dim(
+            buf, val.astype(buf.dtype), pos, axis=1)
+    return jax.vmap(
+        lambda b_, v_, p_: jax.lax.dynamic_update_slice_in_dim(
+            b_, v_.astype(b_.dtype), p_, axis=0))(buf, val, pos)
 
 # ------------------------------------------------ round-2 tensor additions
 register("take_flat", lambda x, idx, mode="clip":

@@ -33,7 +33,8 @@ def _lru_compiled(store, key, build, cap=8):
 
 
 def _update_prealloc_cache(cache, k, v, s):
-    """Write k/v at cache['pos'] and return full buffers + bool attn mask."""
+    """Write k/v at cache['pos'] and return full buffers + bool attn mask.
+    pos may be scalar (shared offset) or [b] (per-row offsets)."""
     from .. import tensor_api as T
     from ..ops import call as ops_call
     pos = cache["pos"]
@@ -42,8 +43,15 @@ def _update_prealloc_cache(cache, k, v, s):
     K, V = cache["k"], cache["v"]
     L = K.shape[1]
     cols = T.arange(L, dtype="int32").unsqueeze(0)          # [1, L]
-    rows = (pos.astype("int32") + T.arange(s, dtype="int32")).unsqueeze(1)
-    mask = (cols <= rows).reshape([1, 1, s, L])
+    if pos.ndim == 0:
+        rows = (pos.astype("int32")
+                + T.arange(s, dtype="int32")).unsqueeze(1)   # [s, 1]
+        mask = (cols <= rows).reshape([1, 1, s, L])
+    else:
+        rows = (pos.astype("int32").unsqueeze(1)
+                + T.arange(s, dtype="int32").unsqueeze(0))   # [b, s]
+        mask = (rows.unsqueeze(2) >= cols.unsqueeze(0)       # [b, s, L]
+                ).unsqueeze(1)                               # [b, 1, s, L]
     return K, V, mask
 
 
@@ -54,6 +62,17 @@ def _sample(logits, key, do_sample, temperature, top_k, top_p):
         return jnp.argmax(logits, axis=-1)
     return jax.random.categorical(
         key, filter_logits(logits, temperature, top_k, top_p), axis=-1)
+
+
+def _truncate_at_eos(out, prompt_len, eos_token_id):
+    """Match the eager loop's early-exit shape: truncate after the LAST
+    row finishes (positions past a row's eos are eos-padded)."""
+    import numpy as np
+    host = np.asarray(out)
+    gen = host[:, prompt_len:]
+    hit = gen == eos_token_id
+    first = np.where(hit.any(1), hit.argmax(1), gen.shape[1] - 1)
+    return host[:, :prompt_len + int(first.max()) + 1]
 
 
 def _model_step(model, pn, bn, p_arrays, b_arrays, ids, cache_arrays, pos):
@@ -139,14 +158,7 @@ def jit_generate(model, input_ids, max_new_tokens=20, do_sample=False,
         fn = _lru_compiled(cache, cache_key, _build)
         out = fn(p_arrays, b_arrays, input_ids._array, cache_arrays, key)
         if eos_token_id is not None:
-            # match the eager loop's early-exit shape: truncate after the
-            # last row finishes (positions past a row's eos are eos-padded)
-            import numpy as np
-            host = np.asarray(out)
-            gen = host[:, prompt_len:]
-            hit = gen == eos_token_id
-            first = np.where(hit.any(1), hit.argmax(1), gen.shape[1] - 1)
-            out = host[:, :prompt_len + int(first.max()) + 1]
+            out = _truncate_at_eos(out, prompt_len, eos_token_id)
         return Tensor._from_array(jnp.asarray(out))
     finally:
         if was_training:
@@ -154,35 +166,46 @@ def jit_generate(model, input_ids, max_new_tokens=20, do_sample=False,
 
 
 def speculative_generate(model, draft_model, input_ids, max_new_tokens=20,
-                         num_speculative_tokens=4):
-    """Greedy speculative decoding (reference analog: PaddleNLP's
-    speculative/draft-model inference; Leviathan et al. 2023 with
-    exact-match acceptance).
+                         num_speculative_tokens=4, do_sample=False,
+                         temperature=1.0, top_k=None, top_p=None,
+                         eos_token_id=None, seed_key=None):
+    """Speculative decoding, batched (reference analog: PaddleNLP's
+    speculative/draft-model inference; Leviathan et al. 2023).
 
     The draft model proposes ``num_speculative_tokens`` tokens per round;
     ONE multi-token target forward verifies them (the preallocated-cache
-    step already builds the correct [s, L] causal mask at any position,
-    _update_prealloc_cache), the longest matching prefix is accepted, and
-    the target's own argmax supplies the correction token.  Because
-    acceptance is exact-match against the target's greedy choice, the
-    output is IDENTICAL to ``jit_generate(model, ..., do_sample=False)``
-    — the draft only changes how many target forwards are needed.
+    step builds the correct [b, 1, s, L] mask at per-row positions,
+    _update_prealloc_cache), and the accepted prefix plus one
+    correction/bonus token is committed per row:
+
+    * greedy (``do_sample=False``): exact-match acceptance against the
+      target's argmax — output IDENTICAL to
+      ``jit_generate(model, ..., do_sample=False)``; the draft only
+      changes how many target forwards are needed.
+    * sampling (``do_sample=True``): the standard stochastic rule —
+      draft token x accepted with prob ``min(1, p(x)/q(x))`` (p/q the
+      temperature/top-k/top-p-FILTERED target/draft distributions, the
+      same distributions the direct sampler draws from); on rejection
+      the replacement is drawn from ``norm(max(p - q, 0))``, on full
+      acceptance the bonus comes from p.  Marginally the output is
+      distributed exactly as direct sampling from the target.
+
+    Batch b >= 1: every row keeps its own cache position, acceptance
+    length, and finished flag; rows that hit ``eos_token_id`` (or their
+    token budget) stop writing while the rest continue.
 
     TPU-native: the ENTIRE loop (draft scan + verify + acceptance) is one
     jitted lax.while_loop program — no host round-trips per round; cache
-    "rewind" after rejection is free (stale entries sit beyond the pos
-    scalar, masked out and later overwritten).
-
-    Batch 1 only (rows would diverge in acceptance length).
+    "rewind" after rejection is free (stale entries sit beyond each
+    row's pos, masked out and later overwritten).
     """
+    from ..framework import random as _random
+    from .generation import filter_logits
+
     k = int(num_speculative_tokens)
     if k < 1:
         raise ValueError("num_speculative_tokens must be >= 1")
     b, prompt_len = input_ids.shape
-    if b != 1:
-        raise NotImplementedError(
-            "speculative_generate supports batch 1 (acceptance length "
-            "diverges per row)")
     total = prompt_len + max_new_tokens
 
     was_t, was_d = model.training, draft_model.training
@@ -197,74 +220,160 @@ def speculative_generate(model, draft_model, input_ids, max_new_tokens=20,
                                          max_length=total + k + 1)
         cache_t = [(c["k"]._array, c["v"]._array) for c in proto_t]
         cache_d = [(c["k"]._array, c["v"]._array) for c in proto_d]
+        key = seed_key if seed_key is not None else _random.next_key()
 
         # the compiled program closes over BOTH modules' structures, so
         # the draft's identity must key the cache too
-        ckey = (prompt_len, max_new_tokens, k, id(draft_model))
+        ckey = (prompt_len, max_new_tokens, k, b, bool(do_sample),
+                float(temperature), top_k, top_p, eos_token_id,
+                id(draft_model))
         jcache = model.__dict__.setdefault("_spec_decode_cache", {})
 
         def _build():
-            def pure(p_t_, b_t_, p_d_, b_d_, ids, cache_t, cache_d):
+            def _probs(logits):
+                """The filtered distribution the direct sampler draws
+                from — p and q MUST both be post-filter for the
+                accept/residual algebra to target it."""
+                return jax.nn.softmax(
+                    filter_logits(logits.astype(jnp.float32), temperature,
+                                  top_k, top_p), axis=-1)
+
+            def _pick(logits, sub):
+                return _sample(logits, sub, do_sample, temperature, top_k,
+                               top_p).astype(jnp.int32)
+
+            def pure(p_t_, b_t_, p_d_, b_d_, ids, cache_t, cache_d, key):
                 ids = ids.astype(jnp.int32)
-                zero = jnp.asarray(0, jnp.int32)
+                zeros_b = jnp.zeros((b,), jnp.int32)
                 t_lg, cache_t = _model_step(model, pn_t, bn_t, p_t_, b_t_,
-                                            ids, cache_t, zero)
+                                            ids, cache_t, zeros_b)
                 _, cache_d = _model_step(draft_model, pn_d, bn_d, p_d_,
-                                         b_d_, ids, cache_d, zero)
-                cur = jnp.argmax(t_lg[0, -1, :]).astype(jnp.int32)
-                buf = jnp.zeros((total + k + 1,), jnp.int32)
-                buf = lax.dynamic_update_slice(buf, ids[0], (0,))
-                buf = buf.at[prompt_len].set(cur)
-                n = jnp.asarray(1, jnp.int32)
-                pos = jnp.asarray(prompt_len, jnp.int32)
+                                         b_d_, ids, cache_d, zeros_b)
+                key, sub = jax.random.split(key)
+                cur = _pick(t_lg[:, -1, :], sub)            # [b]
+                fill = eos_token_id if eos_token_id is not None else 0
+                buf = jnp.full((b, total + k + 1), fill, jnp.int32)
+                buf = lax.dynamic_update_slice(buf, ids, (0, 0))
+                buf = buf.at[:, prompt_len].set(cur)
+                n = jnp.ones((b,), jnp.int32)
+                pos = jnp.full((b,), prompt_len, jnp.int32)
+                fin = jnp.zeros((b,), bool)
+                if eos_token_id is not None:
+                    fin = cur == eos_token_id
+                fin = fin | (n >= max_new_tokens)
 
                 def cond(state):
-                    return state[0] < max_new_tokens
+                    return jnp.any(~state[6])
 
                 def body(state):
-                    n, buf, cur, pos, cache_t, cache_d = state
+                    n, buf, cur, pos, cache_t, cache_d, fin, key = state
+                    key, kdraft, kacc, krepl = jax.random.split(key, 4)
 
-                    def dstep(carry, _):
+                    def dstep(carry, sub):
                         tok, cd, dpos = carry
                         lg, cd = _model_step(
                             draft_model, pn_d, bn_d, p_d_, b_d_,
-                            tok[None, None], cd, dpos)
-                        nxt = jnp.argmax(lg[0, -1, :]).astype(jnp.int32)
-                        return (nxt, cd, dpos + 1), nxt
+                            tok[:, None], cd, dpos)
+                        lg = lg[:, -1, :]
+                        nxt = _pick(lg, sub)
+                        out = (nxt, _probs(lg)) if do_sample else nxt
+                        return (nxt, cd, dpos + 1), out
 
                     # k+1 draft steps: the last one's PROPOSAL is unused,
                     # but its cache write stores d_k's kv — without it a
                     # fully-accepted round leaves a hole at pos+k that
                     # would silently degrade later draft proposals
-                    (_, cache_d, _), props_all = lax.scan(
-                        dstep, (cur, cache_d, pos), None, length=k + 1)
-                    props = props_all[:k]
-                    # verify [cur, d1..dk] (k+1 rows) in ONE target
+                    (_, cache_d, _), outs = lax.scan(
+                        dstep, (cur, cache_d, pos),
+                        jax.random.split(kdraft, k + 1))
+                    if do_sample:
+                        props = outs[0][:k].T               # [b, k]
+                        qs = jnp.moveaxis(outs[1][:k], 0, 1)  # [b, k, V]
+                    else:
+                        props = outs[:k].T                  # [b, k]
+                    # verify [cur, d1..dk] (k+1 cols) in ONE target
                     # forward so every paid-for proposal is checked;
-                    # logits[j] chooses the token at index pos+j+1
-                    verify = jnp.concatenate([cur[None], props])[None, :]
+                    # logits[:, j] chooses the token at each row's
+                    # pos + j + 1
+                    verify = jnp.concatenate([cur[:, None], props], axis=1)
                     t_lg, cache_t = _model_step(
                         model, pn_t, bn_t, p_t_, b_t_, verify, cache_t,
                         pos)
-                    greedy = jnp.argmax(t_lg[0], axis=-1).astype(jnp.int32)
-                    eq = (props == greedy[:k]).astype(jnp.int32)
-                    m = jnp.sum(jnp.cumprod(eq))        # accepted: 0..k
-                    emit = m + 1                        # + correction/bonus
-                    # write all k candidates; rounds overwrite beyond emit
-                    buf = lax.dynamic_update_slice(buf, greedy,
-                                                   (prompt_len + n,))
-                    return (n + emit, buf, greedy[m], pos + emit,
-                            cache_t, cache_d)
+                    idx = jnp.arange(k + 1)[None, :]        # [1, k+1]
+                    if do_sample:
+                        ps = _probs(t_lg)                   # [b, k+1, V]
+                        take = lambda d, t: jnp.take_along_axis(
+                            d, t[..., None], axis=-1)[..., 0]
+                        p_tok = take(ps[:, :k, :], props)   # [b, k]
+                        q_tok = take(qs, props)             # [b, k]
+                        u = jax.random.uniform(kacc, (b, k))
+                        acc = (u * q_tok < p_tok).astype(jnp.int32)
+                        m = jnp.sum(jnp.cumprod(acc, axis=1), axis=1)
+                        # replacement draw at EVERY position: residual
+                        # norm(max(p-q,0)) for 0..k-1, bonus p at k; only
+                        # the draw at index m is ever committed
+                        res = jnp.maximum(ps[:, :k, :] - qs, 0.0)
+                        rs = jnp.sum(res, axis=-1, keepdims=True)
+                        # p==q makes the residual empty; rejection there
+                        # has prob 0, guard the 0/0 with p itself
+                        res = jnp.where(rs > 0, res / rs, ps[:, :k, :])
+                        cand = jnp.concatenate([res, ps[:, k:, :]], axis=1)
+                        repl = jax.random.categorical(
+                            krepl, jnp.log(cand + 1e-30),
+                            axis=-1).astype(jnp.int32)      # [b, k+1]
+                        props_pad = jnp.concatenate(
+                            [props, repl[:, -1:]], axis=1)
+                        tok_out = jnp.where(idx < m[:, None],
+                                            props_pad, repl)
+                    else:
+                        greedy = jnp.argmax(t_lg, axis=-1).astype(jnp.int32)
+                        acc = (props == greedy[:, :k]).astype(jnp.int32)
+                        m = jnp.sum(jnp.cumprod(acc, axis=1), axis=1)
+                        tok_out = greedy        # [b, k+1]; valid thru m
+                    cur_next = jnp.take_along_axis(
+                        tok_out, m[:, None], axis=1)[:, 0]
+                    emit = m + 1                            # [b], 1..k+1
+                    if eos_token_id is not None:
+                        hit = (tok_out == eos_token_id) & (idx <= m[:, None])
+                        any_hit = jnp.any(hit, axis=1)
+                        e = jnp.argmax(hit, axis=1)
+                        emit = jnp.where(any_hit,
+                                         jnp.minimum(emit, e + 1), emit)
+                        # eos-pad the committed window past the first eos
+                        tok_out = jnp.where(
+                            any_hit[:, None] & (idx > e[:, None]),
+                            eos_token_id, tok_out)
+                        new_fin = fin | any_hit
+                    else:
+                        new_fin = fin
+                    emit = jnp.where(fin, 0, emit)
 
-                state = (n, buf, cur, pos, cache_t, cache_d)
-                n, buf, cur, pos, cache_t, cache_d = lax.while_loop(
-                    cond, body, state)
-                return buf[:total][None, :]
+                    def row_write(rowbuf, toks, start, f):
+                        upd = lax.dynamic_update_slice(rowbuf, toks,
+                                                       (start,))
+                        return jnp.where(f, rowbuf, upd)
+
+                    buf = jax.vmap(row_write)(buf, tok_out,
+                                              prompt_len + n, fin)
+                    cur = jnp.where(fin, cur, cur_next)
+                    n = n + emit
+                    pos = pos + emit
+                    new_fin = new_fin | (n >= max_new_tokens)
+                    return (n, buf, cur, pos, cache_t, cache_d,
+                            new_fin, key)
+
+                state = (n, buf, cur, pos, cache_t, cache_d, fin, key)
+                state = lax.while_loop(cond, body, state)
+                return state[1][:, :total]
 
             return jax.jit(pure)
 
         fn = _lru_compiled(jcache, ckey, _build)
-        out = fn(p_t, b_t, p_d, b_d, input_ids._array, cache_t, cache_d)
+        out = fn(p_t, b_t, p_d, b_d, input_ids._array, cache_t, cache_d,
+                 key)
+        if eos_token_id is not None:
+            out = jnp.asarray(
+                _truncate_at_eos(out, prompt_len, eos_token_id))
         return Tensor._from_array(out)
     finally:
         if was_t:

@@ -39,22 +39,19 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
              temperature=1.0, top_k=None, top_p=None, eos_token_id=None,
              draft_model=None, num_speculative_tokens=4):
     """Returns Tensor [b, prompt + new] of token ids.  Passing
-    ``draft_model`` routes greedy decoding through speculative decoding
-    (decode.speculative_generate — token-identical output, fewer target
-    forwards)."""
+    ``draft_model`` routes through speculative decoding
+    (decode.speculative_generate): greedy output is token-identical to
+    the plain path; sampled output is distributionally equivalent (the
+    stochastic acceptance rule preserves the target's sampling law but
+    consumes a different RNG stream, so individual tokens differ)."""
     if draft_model is not None:
-        if do_sample:
-            raise NotImplementedError(
-                "speculative decoding is greedy-only (exact-match "
-                "acceptance); drop draft_model or do_sample")
-        if eos_token_id is not None:
-            raise NotImplementedError(
-                "speculative decoding does not trim at eos_token_id yet")
         from .decode import speculative_generate
         # both paths yield int32 ids (Tensor wrapping canonicalizes 64-bit)
         return speculative_generate(
             model, draft_model, input_ids, max_new_tokens=max_new_tokens,
-            num_speculative_tokens=num_speculative_tokens)
+            num_speculative_tokens=num_speculative_tokens,
+            do_sample=do_sample, temperature=temperature, top_k=top_k,
+            top_p=top_p, eos_token_id=eos_token_id)
     was_training = model.training
     model.eval()
     try:

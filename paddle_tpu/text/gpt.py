@@ -215,10 +215,14 @@ class GPTModel(nn.Layer):
         if position_ids is None:
             if caches is not None and caches[0] is not None \
                     and "pos" in caches[0]:
-                # preallocated cache: offset is a traced scalar
-                position_ids = (T.arange(0, s, dtype="int32")
-                                + caches[0]["pos"].astype("int32"))
-                position_ids = position_ids.unsqueeze(0)
+                # preallocated cache: offset is a traced scalar, or a [b]
+                # vector (per-row decode offsets, batched speculative)
+                p = caches[0]["pos"].astype("int32")
+                ar = T.arange(0, s, dtype="int32")
+                if p.ndim == 0:
+                    position_ids = (ar + p).unsqueeze(0)
+                else:
+                    position_ids = p.unsqueeze(1) + ar.unsqueeze(0)
             else:
                 offset = 0
                 if caches is not None and caches[0] is not None:

@@ -111,8 +111,10 @@ class LlamaAttention(nn.Layer):
         prealloc = cache is not None and "pos" in cache
         if prealloc:
             def rope_fn(qa, ka, pa, theta=cfg.rope_theta):
-                pos = (pa.astype(jnp.int32)
-                       + jnp.arange(qa.shape[1]))[None, :]
+                # pa: scalar offset, or [b] per-row offsets (batched
+                # speculative decode) -> positions [1|b, s]
+                base = jnp.atleast_1d(pa.astype(jnp.int32))
+                pos = base[:, None] + jnp.arange(qa.shape[1])[None, :]
                 return _rope(qa, ka, pos, theta)
             q, k = engine.apply("rope", rope_fn, [q, k, cache["pos"]])
         else:

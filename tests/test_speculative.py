@@ -80,11 +80,96 @@ class TestSpeculative:
         plain = generate(target, prompt, max_new_tokens=6)
         assert str(got_t.dtype) == str(plain.dtype)   # path-consistent ids
         np.testing.assert_array_equal(got_t.numpy(), want)
-        with pytest.raises(NotImplementedError, match="greedy-only"):
-            generate(target, prompt, draft_model=draft, do_sample=True)
+        # sampling routes through the stochastic acceptance path
+        out = generate(target, prompt, max_new_tokens=6, draft_model=draft,
+                       do_sample=True, temperature=1.2).numpy()
+        assert out.shape == (1, prompt.shape[1] + 6)
 
-    def test_batch_gt1_raises(self, target):
-        ids = pt.to_tensor(np.zeros((2, 4), np.int64))
+    def test_batched_greedy_matches_jit_generate(self, target):
+        # per-row cache positions: rows accept DIFFERENT draft prefixes
+        # each round yet every row must equal its own greedy decode
         draft = _model(1, 16, 7)
-        with pytest.raises(NotImplementedError, match="batch 1"):
-            speculative_generate(target, draft, ids)
+        ids = pt.to_tensor(np.array(
+            [[5, 17, 40, 3], [1, 2, 3, 4], [90, 8, 77, 6]], np.int64))
+        want = jit_generate(target, ids, max_new_tokens=12).numpy()
+        got = speculative_generate(target, draft, ids, max_new_tokens=12,
+                                   num_speculative_tokens=3).numpy()
+        np.testing.assert_array_equal(got, want)
+
+    def test_batched_eos_matches_jit_generate(self, target):
+        draft = _model(1, 16, 7)
+        ids = pt.to_tensor(np.array(
+            [[5, 17, 40, 3], [1, 2, 3, 4], [90, 8, 77, 6]], np.int64))
+        plain = jit_generate(target, ids, max_new_tokens=12).numpy()
+        eos = int(plain[0, 4 + 3])        # a token greedy REALLY emits
+        want = jit_generate(target, ids, max_new_tokens=12,
+                            eos_token_id=eos).numpy()
+        got = speculative_generate(target, draft, ids, max_new_tokens=12,
+                                   num_speculative_tokens=4,
+                                   eos_token_id=eos).numpy()
+        np.testing.assert_array_equal(got, want)
+
+
+class TestSpeculativeSampling:
+    """Stochastic acceptance (Leviathan et al.): accept draft x with prob
+    min(1, p(x)/q(x)), resample rejections from norm(max(p-q, 0)) — the
+    OUTPUT DISTRIBUTION equals direct sampling from the target, for any
+    draft.  Checked distribution-level (total variation on marginals)."""
+
+    def test_matches_direct_sampling_distribution(self):
+        import jax
+        tgt = _small_vocab_model(2, 32, 5)
+        drf = _small_vocab_model(1, 16, 77)
+        B, R, NEW = 256, 4, 3
+        prompt = pt.to_tensor(
+            np.tile(np.array([[3, 9, 1, 14]], np.int64), (B, 1)))
+
+        def collect(fn):
+            return np.concatenate(
+                [fn(jax.random.PRNGKey(1000 + r))[:, 4:]
+                 for r in range(R)], 0)
+
+        direct = collect(lambda k: jit_generate(
+            tgt, prompt, max_new_tokens=NEW, do_sample=True,
+            temperature=1.2, seed_key=k).numpy())
+        spec = collect(lambda k: speculative_generate(
+            tgt, drf, prompt, max_new_tokens=NEW, do_sample=True,
+            temperature=1.2, num_speculative_tokens=3, seed_key=k).numpy())
+        for pos in range(NEW):
+            cd = np.bincount(direct[:, pos], minlength=16) / len(direct)
+            cs = np.bincount(spec[:, pos], minlength=16) / len(spec)
+            tv = 0.5 * np.abs(cd - cs).sum()
+            # 1024 samples, vocab 16: sampling noise ~0.07; equal laws
+            # stay well under 0.15, a wrong acceptance rule does not
+            assert tv < 0.15, (pos, tv)
+
+    def test_topk_support(self, ):
+        import jax
+        tgt = _model(3, 48, 11)
+        drf = _model(1, 16, 99)
+        ids = pt.to_tensor(np.array(
+            [[5, 17, 40, 3], [1, 2, 3, 4], [90, 8, 77, 6]], np.int64))
+        out = speculative_generate(
+            tgt, drf, ids, max_new_tokens=8, do_sample=True, top_k=5,
+            num_speculative_tokens=3,
+            seed_key=jax.random.PRNGKey(0)).numpy()
+        # teacher-force the output: every generated token must be inside
+        # the TARGET's top-5 for its prefix (draft proposals outside the
+        # filtered support must never survive acceptance/resampling)
+        from paddle_tpu.autograd import engine
+        with engine.no_grad():
+            lg = tgt(pt.to_tensor(out.astype(np.int64))).numpy()
+        for r in range(out.shape[0]):
+            for i in range(4, out.shape[1]):
+                topk = np.argsort(lg[r, i - 1])[-5:]
+                assert out[r, i] in topk, (r, i)
+
+
+def _small_vocab_model(layers, hidden, seed):
+    pt.seed(seed)
+    cfg = GPTConfig(vocab_size=16, hidden_size=hidden, num_layers=layers,
+                    num_heads=4, max_position_embeddings=64,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m

@@ -14,6 +14,9 @@ set -u
 cd "$(dirname "$0")/.."
 STAMP=$(date +%s)
 DRAINED=0
+# one kill must suffice: take the children (an in-flight bench.py would
+# otherwise keep holding the TPU claim against the driver's capture)
+trap 'kill 0' EXIT TERM INT
 
 measured_since_start() {
     python - "$STAMP" <<'EOF'
