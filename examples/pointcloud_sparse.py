@@ -7,7 +7,15 @@ sites), global pooling over stored values, and a dense head.  All conv
 compute is gather -> stacked-einsum -> scatter over the ACTIVE sites —
 FLOPs scale with occupancy, not with the 32^3 volume.
 
-    python examples/pointcloud_sparse.py [--cpu] [--steps N]
+Two modes:
+  * default: eager tape training (exact data-dependent site tables).
+  * --jit:   the ENTIRE train step (sparse convs + BN + head + Adam) is
+             ONE fused XLA program via pt.jit.train_step — the site
+             tables switch to static-capacity padding automatically
+             (sparse/nn.py), so the program compiles once for a fixed
+             nnz and is replayed every step.
+
+    python examples/pointcloud_sparse.py [--cpu] [--jit] [--steps N]
 """
 import os
 import sys
@@ -16,29 +24,72 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import numpy as np
 
+VOL, C, NCLS, NSITES = 32, 4, 4, 192
 
-def random_cloud(rng, n_classes=4, vol=32, nsites=256, C=4):
+
+def random_cloud(rng, n_classes=NCLS, vol=VOL, nsites=NSITES, C=C):
     """Synthetic 'shapes': each class concentrates sites along a
-    different axis-aligned slab so the task is learnable."""
+    different axis-aligned slab so the task is learnable.  Always
+    returns EXACTLY ``nsites`` unique sites (fixed nnz -> the jitted
+    step compiles once)."""
     y = rng.randint(n_classes)
     axis = y % 3
     center = vol // 4 + (y // 3) * vol // 2
-    coords = rng.randint(0, vol, size=(nsites, 3))
-    coords[:, axis] = np.clip(
-        rng.randint(center - 3, center + 3, size=nsites), 0, vol - 1)
-    coords = np.unique(coords, axis=0)
-    feats = rng.randn(len(coords), C).astype(np.float32)
+    coords = np.empty((0, 3), np.int64)
+    while len(coords) < nsites:
+        c = rng.randint(0, vol, size=(2 * nsites, 3))
+        c[:, axis] = np.clip(
+            rng.randint(center - 3, center + 3, size=2 * nsites), 0,
+            vol - 1)
+        coords = np.unique(np.concatenate([coords, c]), axis=0)
+    sel = rng.permutation(len(coords))[:nsites]
+    coords = coords[sel]
+    feats = rng.randn(nsites, C).astype(np.float32)
     return coords, feats, y
 
 
-def to_coo(pt, sparse, coords, feats, vol, C):
+def cloud_batch(pt, coords, feats):
+    """[5, S*C] indices + [S*C] values Tensors (the jit-traceable form:
+    the COO is rebuilt from these INSIDE the traced forward)."""
     n = np.zeros((len(coords), 1), np.int64)
     site_idx = np.concatenate([n, coords], axis=1)     # [S, 4]
     idx = np.repeat(site_idx, C, axis=0)
     ch = np.tile(np.arange(C), len(coords))[:, None]
     indices = np.concatenate([idx, ch], axis=1).T       # [5, S*C]
-    return sparse.sparse_coo_tensor(indices, feats.reshape(-1),
-                                    shape=(1, vol, vol, vol, C))
+    return (pt.to_tensor(indices.astype(np.int32)),
+            pt.to_tensor(feats.reshape(-1)))
+
+
+def build_model(pt):
+    from paddle_tpu import sparse
+    from paddle_tpu.sparse import nn as spnn
+
+    class SparseVoxelNet(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.c1 = spnn.SubmConv3D(C, 16, kernel_size=3)
+            self.b1 = spnn.BatchNorm(16)
+            self.c2 = spnn.SubmConv3D(16, 16, kernel_size=3)
+            self.b2 = spnn.BatchNorm(16)
+            self.c3 = spnn.Conv3D(16, 32, kernel_size=3, stride=2,
+                                  padding=1)
+            self.head = pt.nn.Linear(32, NCLS)
+
+        def forward(self, indices, values):
+            x = sparse.sparse_coo_tensor(indices, values,
+                                         shape=(1, VOL, VOL, VOL, C))
+            x = sparse.relu(self.b1(self.c1(x)))
+            x = sparse.relu(self.b2(self.c2(x)))
+            x = self.c3(x)
+            # global SUM pooling over stored values per channel — exact
+            # in both modes (the jit path's padded rows are zeros; a
+            # mean would divide by the padded capacity instead of the
+            # real site count)
+            vals = x.values().reshape([-1, 32])
+            return self.head(vals.sum(axis=0, keepdim=True)
+                             * (1.0 / NSITES))
+
+    return SparseVoxelNet()
 
 
 def main():
@@ -47,46 +98,44 @@ def main():
         description="sparse voxel classifier (SubmConv3D/Conv3D stack)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend")
+    ap.add_argument("--jit", action="store_true",
+                    help="fuse the whole train step into one XLA program")
     ap.add_argument("--steps", type=int, default=30)
     args = ap.parse_args()
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
     import paddle_tpu as pt
-    from paddle_tpu import sparse
-    from paddle_tpu.sparse import nn as spnn
     import paddle_tpu.nn.functional as F
 
-    VOL, C, NCLS = 32, 4, 4
     pt.seed(0)
-    net = [spnn.SubmConv3D(C, 16, kernel_size=3),
-           spnn.BatchNorm(16), spnn.ReLU(),
-           spnn.SubmConv3D(16, 16, kernel_size=3),
-           spnn.BatchNorm(16), spnn.ReLU(),
-           spnn.Conv3D(16, 32, kernel_size=3, stride=2, padding=1)]
-    head = pt.nn.Linear(32, NCLS)
-    params = [p for layer in net for p in layer.parameters()] \
-        + list(head.parameters())
-    opt = pt.optimizer.Adam(learning_rate=2e-3, parameters=params)
+    model = build_model(pt)
+    opt = pt.optimizer.Adam(learning_rate=2e-3,
+                            parameters=model.parameters())
+
+    def loss_fn(m, indices, values, label):
+        return F.cross_entropy(m(indices, values), label,
+                               reduction="mean")
+
+    step = pt.jit.train_step(model, loss_fn, opt) if args.jit else None
 
     rng = np.random.RandomState(0)
-    for step in range(args.steps):
-        coords, feats, y = random_cloud(rng, NCLS, VOL)
-        x = to_coo(pt, sparse, coords, feats, VOL, C)
-        for layer in net:
-            x = layer(x)
-        # global mean over stored values per channel (values-only, like
-        # the point-cloud pooling heads)
-        vals = x.values().reshape([-1, 32])
-        logits = head(vals.mean(axis=0, keepdim=True))
-        loss = F.cross_entropy(logits, pt.to_tensor(np.array([y])))
-        loss.backward()
-        opt.step()
-        opt.clear_grad()
-        if step % 10 == 0 or step == args.steps - 1:
-            print(f"step {step:2d}  sites={x.nnz() // 32:4d}  "
+    for it in range(args.steps):
+        coords, feats, y = random_cloud(rng)
+        indices, values = cloud_batch(pt, coords, feats)
+        label = pt.to_tensor(np.array([y]))
+        if step is not None:
+            loss = step(indices, values, label)
+        else:
+            loss = loss_fn(model, indices, values, label)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        if it % 10 == 0 or it == args.steps - 1:
+            print(f"step {it:2d}  sites={NSITES:4d}  "
                   f"loss={float(loss):.4f}")
-    print("done — sparse conv stack trains end-to-end")
+    print("done — sparse conv stack trains end-to-end"
+          + (" (one fused XLA program)" if args.jit else ""))
 
 
 if __name__ == "__main__":

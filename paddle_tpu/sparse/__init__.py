@@ -189,10 +189,22 @@ def divide(x, y):
                                         shape=b.shape))
 
 
+def _propagate_pattern(out, x):
+    """Pattern-preserving ops (relu, BatchNorm, ...) carry the conv
+    site-table cache (_site_sig), the static site-capacity bound, and
+    the static-padding per-entry validity mask to their output."""
+    for attr in ("_site_sig", "_site_capacity", "_entry_valid"):
+        v = getattr(x, attr, None)
+        if v is not None:
+            setattr(out, attr, v)
+    return out
+
+
 def relu(x):
     b = _coo(x)
-    return SparseCooTensor(jsparse.BCOO((jax.nn.relu(b.data), b.indices),
-                                        shape=b.shape))
+    out = SparseCooTensor(jsparse.BCOO((jax.nn.relu(b.data), b.indices),
+                                       shape=b.shape))
+    return _propagate_pattern(out, x)
 
 
 # ------------------------------------------------------------------- matmul

@@ -1,20 +1,30 @@
 """paddle.sparse.nn (reference: python/paddle/sparse/nn — sparse conv /
 BN / activation layers for point-cloud workloads).
 
-TPU backing (round 4):
+TPU backing (round 4, jit-ready round 5):
   * SubmConv3D AND strided Conv3D are REAL sparse compute — gather ->
     matmul -> scatter over the BCOO indices with compute proportional to
     nnz: unique active sites by sort/searchsorted on linearized
-    coordinates (_prep_sparse_conv; strided output sites are the
+    coordinates (_site_tables; strided output sites are the
     stride-grid union of active receptive fields), neighbor rows
     gathered per kernel offset, and ONE stacked einsum ("ksi,kio->so")
     contracts all K offsets on the MXU.  FLOPs scale with the number of
     active sites, not the volume (tests/test_sparse_conv.py pins this
     with XLA cost_analysis).
-  * BatchNorm runs over the non-zero VALUES only (segment_sum per
-    channel — already compute proportional to nnz).
-  * groups>1 and int32-key-overflow volumes fall back to the
-    dense-masked formulation (same semantics, dense compute).
+  * JIT/to_static-compatible (round 5): under a trace the site tables
+    switch to STATIC CAPACITIES (unique padded to nnz, strided output
+    sites to K*nnz) with sentinel masking — the MoE static-capacity
+    pattern — so sparse point-cloud training compiles into one XLA
+    program.  Padded rows contribute exact zeros; in static mode the
+    output pattern may carry explicit zero entries at clipped
+    coordinates (dense values are exact).
+  * Site/neighbor tables are resolved ONCE per pattern x geometry and
+    shared through pattern-preserving layers (SubmConv3D/BatchNorm/ReLU
+    propagate a _SiteSig token), so a deep submanifold stack pays the
+    sort/searchsorted index work once, not per layer.
+  * groups>1 runs sparse too (block-diagonal "ksgi,kigo->sgo" einsum);
+    only int32-key-overflow volumes fall back to the dense-masked
+    formulation (same semantics, dense compute).
 """
 from __future__ import annotations
 
@@ -57,16 +67,23 @@ class BatchNorm(Layer):
 
     def forward(self, x):
         import jax
+        from . import _propagate_pattern
         b = _coo(x)
         vals = b.data                     # (nnz,) scalar entries
         C = b.shape[-1]
         ch = b.indices[:, -1]             # channel id per non-zero
+        # static-capacity padding (jit path): padded entries must not
+        # dilute the statistics, and must STAY zero on the way out (a
+        # nonzero padded row would corrupt the clipped corner voxel on
+        # densify and downstream scatters)
+        valid = getattr(x, "_entry_valid", None)
+        ones = jnp.ones_like(vals) if valid is None \
+            else valid.astype(vals.dtype)
         if self.training:
-            counts = jnp.maximum(
-                jax.ops.segment_sum(jnp.ones_like(vals), ch, C), 1.0)
-            mean = jax.ops.segment_sum(vals, ch, C) / counts
+            counts = jnp.maximum(jax.ops.segment_sum(ones, ch, C), 1.0)
+            mean = jax.ops.segment_sum(vals * ones, ch, C) / counts
             var = jax.ops.segment_sum(
-                (vals - mean[ch]) ** 2, ch, C) / counts
+                ((vals - mean[ch]) ** 2) * ones, ch, C) / counts
             m = self.momentum
             self._mean._inplace_assign(m * self._mean._array
                                        + (1 - m) * mean)
@@ -76,8 +93,12 @@ class BatchNorm(Layer):
             mean, var = self._mean._array, self._variance._array
         out = (vals - mean[ch]) / jnp.sqrt(var[ch] + self.eps)
         out = out * self.weight._array[ch] + self.bias._array[ch]
-        return SparseCooTensor(jsparse.BCOO((out, b.indices),
-                                            shape=b.shape))
+        if valid is not None:
+            out = jnp.where(valid, out, 0.0)
+        res = SparseCooTensor(jsparse.BCOO((out, b.indices),
+                                           shape=b.shape))
+        res._site_sig = _sig_of(x)        # pattern-preserving
+        return _propagate_pattern(res, x)
 
 
 def _lin(n, d, h, w, Dd, H, W):
@@ -90,27 +111,74 @@ def _delin(keys, Dd, H, W):
     return n, rem // (H * W), (rem % (H * W)) // W, rem % W
 
 
-def _prep_sparse_conv(b, kdims, stride, pad, dil, subm):
-    """Eager site/neighbor resolution shared by SubmConv3D and strided
-    Conv3D: unique active INPUT sites by sorted linearized keys; OUTPUT
-    sites = input sites (subm) or the stride-grid union of every
-    offset's receptive-field image (strided); per-offset neighbor rows
-    via searchsorted.  Index work is O((S_in + S_out) * K log S) ints —
-    no dense volume is ever touched.  Returns None when the volume
-    overflows int32 keys (caller falls back to the dense path)."""
+class _SiteSig:
+    """Identity token for a sparse tensor's SITE pattern (indices[:, :4]).
+    Pattern-preserving ops (SubmConv3D, BatchNorm, ReLU) propagate the
+    SAME object to their output, so an N-layer submanifold network
+    resolves its site/neighbor tables once per geometry instead of once
+    per layer — and under a jit trace the cached tables are tracers that
+    die with the trace (the sig lives on the traced wrappers only)."""
+    __slots__ = ("tables",)
+
+    def __init__(self):
+        self.tables = {}
+
+
+def _sig_of(x):
+    s = getattr(x, "_site_sig", None)
+    if s is None:
+        s = x._site_sig = _SiteSig()
+    return s
+
+
+def _is_tracing(b):
+    from jax.core import Tracer
+    return isinstance(b.indices, Tracer) or isinstance(b.data, Tracer)
+
+
+def _site_tables(b, kdims, stride, pad, dil, subm, static, out_capacity,
+                 site_capacity=None, entry_valid=None):
+    """Site/neighbor resolution shared by SubmConv3D and strided Conv3D:
+    unique active INPUT sites by sorted linearized keys; OUTPUT sites =
+    input sites (subm) or the stride-grid union of every offset's
+    receptive-field image (strided); per-offset neighbor rows via
+    searchsorted.  Index work is O((S_in + S_out) * K log S) ints — no
+    dense volume is ever touched.
+
+    Two modes:
+      * eager (static=False): exact sizes (data-dependent shapes).
+      * static (static=True, the JIT path): every data-dependent size is
+        padded to a static capacity — unique input sites to nnz (a true
+        upper bound), strided output sites to K*S_cap (or the caller's
+        ``out_capacity``) — with BIG-key sentinels; padded rows carry
+        hits=False / zeroed features, so they contribute exact zeros.
+        This is the MoE static-capacity pattern applied to point clouds.
+    """
     N, Dd, H, W, _C = b.shape
     kd, kh, kw = kdims
     sd, sh, sw = stride
     pd, ph, pw = pad
-    if N * Dd * H * W >= 2 ** 31:
-        return None
     idx = b.indices
-    coords, ch = idx[:, :4], idx[:, 4]
+    coords = idx[:, :4]
     key_in = _lin(coords[:, 0], coords[:, 1], coords[:, 2], coords[:, 3],
                   Dd, H, W)
-    ukeys = jnp.unique(key_in)
+    BIG = N * Dd * H * W
+    if entry_valid is not None:
+        # upstream static padding: invalid entries sit at CLIPPED
+        # coordinates — mask their keys so no phantom site (which a
+        # nonzero conv bias would light up) enters the site set
+        key_in = jnp.where(entry_valid, key_in, BIG)
+    if static:
+        # nnz >= unique sites always; an upstream conv knows a tighter
+        # bound (its own padded site count) and passes it as site_capacity
+        s_cap = int(idx.shape[0])
+        if site_capacity is not None:
+            s_cap = min(s_cap, int(site_capacity))
+        ukeys = jnp.unique(key_in, size=s_cap, fill_value=BIG)
+    else:
+        ukeys = jnp.unique(key_in)
     S = int(ukeys.shape[0])
-    rank = jnp.searchsorted(ukeys, key_in)
+    site_valid = ukeys < BIG
     un, ud, uh, uw = _delin(ukeys, Dd, H, W)
 
     offsets = [(od, oh, ow) for od in range(kd) for oh in range(kh)
@@ -118,12 +186,11 @@ def _prep_sparse_conv(b, kdims, stride, pad, dil, subm):
     if subm:
         Do, Ho, Wo = Dd, H, W
         on, od_, oh_, ow_ = un, ud, uh, uw
+        out_valid = site_valid
     else:
         Do = (Dd + 2 * pd - dil[0] * (kd - 1) - 1) // sd + 1
         Ho = (H + 2 * ph - dil[1] * (kh - 1) - 1) // sh + 1
         Wo = (W + 2 * pw - dil[2] * (kw - 1) - 1) // sw + 1
-        if N * Do * Ho * Wo >= 2 ** 31:
-            return None
         big = N * Do * Ho * Wo          # sentinel for invalid candidates
         cands = []
         for od, oh, ow in offsets:
@@ -133,11 +200,17 @@ def _prep_sparse_conv(b, kdims, stride, pad, dil, subm):
             ok = ((nd % sd == 0) & (nh % sh == 0) & (nw % sw == 0))
             qd, qh, qw = nd // sd, nh // sh, nw // sw
             ok &= ((qd >= 0) & (qd < Do) & (qh >= 0) & (qh < Ho)
-                   & (qw >= 0) & (qw < Wo))
+                   & (qw >= 0) & (qw < Wo)) & site_valid
             cands.append(jnp.where(ok, _lin(un, qd, qh, qw, Do, Ho, Wo),
                                    big))
-        allk = jnp.unique(jnp.concatenate(cands))
-        okeys = allk[allk < big]        # eager: concrete boolean mask
+        allc = jnp.concatenate(cands)
+        if static:
+            o_cap = min(out_capacity or len(offsets) * S, N * Do * Ho * Wo)
+            okeys = jnp.unique(allc, size=o_cap, fill_value=big)
+        else:
+            allk = jnp.unique(allc)
+            okeys = allk[allk < big]    # eager: concrete boolean mask
+        out_valid = okeys < big
         on, od_, oh_, ow_ = _delin(okeys, Do, Ho, Wo)
 
     gathers, hits = [], []
@@ -148,33 +221,78 @@ def _prep_sparse_conv(b, kdims, stride, pad, dil, subm):
         qh = oh_ * sh - ph + oh * dil[1]
         qw = ow_ * sw - pw + ow * dil[2]
         valid = ((qd >= 0) & (qd < Dd) & (qh >= 0) & (qh < H)
-                 & (qw >= 0) & (qw < W))
+                 & (qw >= 0) & (qw < W)) & out_valid
         qkey = _lin(on, qd, qh, qw, Dd, H, W)
         j = jnp.clip(jnp.searchsorted(ukeys, qkey), 0, max(S - 1, 0))
         hits.append(valid & (ukeys[j] == qkey))
         gathers.append(j)
-    return dict(rank=rank, ch=ch, S=S,
+    return dict(ukeys=ukeys, S=S,
                 jall=jnp.stack(gathers), hall=jnp.stack(hits),
+                out_valid=out_valid,
                 out_sites=jnp.stack([on, od_, oh_, ow_], axis=1),
                 out_dims=(Do, Ho, Wo))
+
+
+def _prep_sparse_conv(b, kdims, stride, pad, dil, subm, sig=None,
+                      out_capacity=None, site_capacity=None,
+                      entry_valid=None):
+    """Tables (cached on the site signature when available) + per-tensor
+    rank/channel columns.  Returns None when the volume overflows int32
+    keys (caller falls back to the dense path).  Jit-safe: under a trace
+    the static-capacity mode is selected automatically."""
+    N, Dd, H, W, _C = b.shape
+    if N * Dd * H * W >= 2 ** 31:
+        return None
+    static = _is_tracing(b)
+    if not subm:
+        kd, kh, kw = kdims
+        sd, sh, sw = stride
+        Do = (Dd + 2 * pad[0] - dil[0] * (kd - 1) - 1) // sd + 1
+        Ho = (H + 2 * pad[1] - dil[1] * (kh - 1) - 1) // sh + 1
+        Wo = (W + 2 * pad[2] - dil[2] * (kw - 1) - 1) // sw + 1
+        if N * Do * Ho * Wo >= 2 ** 31:
+            return None
+    geom = (tuple(kdims), tuple(stride), tuple(pad), tuple(dil), subm,
+            out_capacity)
+    tables = sig.tables.get(geom) if sig is not None else None
+    if tables is None:
+        tables = _site_tables(b, kdims, stride, pad, dil, subm, static,
+                              out_capacity, site_capacity=site_capacity,
+                              entry_valid=entry_valid)
+        if sig is not None:
+            sig.tables[geom] = tables
+    idx = b.indices
+    key_in = _lin(idx[:, 0], idx[:, 1], idx[:, 2], idx[:, 3], Dd, H, W)
+    # invalid (padded) entries carry zero values; clip their rank so the
+    # scatter-add of those zeros stays in bounds
+    S = int(tables["ukeys"].shape[0])
+    rank = jnp.clip(jnp.searchsorted(tables["ukeys"], key_in), 0,
+                    max(S - 1, 0))
+    return dict(tables, rank=rank, ch=idx[:, 4])
 
 
 class Conv3D(Layer):
     """Sparse 3-D conv on (N, D, H, W, C) COO input; output pattern is the
     conv-dilated occupancy (reference: paddle.sparse.nn.Conv3D).
 
-    Real sparse compute since round 4 (groups=1): output sites are the
-    stride-grid union of the active receptive fields, features gather per
-    kernel offset and contract in ONE [K,So,Cin] x [K,Cin,Cout] einsum —
-    FLOPs scale with active sites, not volume.  groups>1 (and int32 key
-    overflow) fall back to the dense-masked formulation."""
+    Real sparse compute since round 4: output sites are the stride-grid
+    union of the active receptive fields, features gather per kernel
+    offset and contract in ONE [K,So,Cin] x [K,Cin,Cout] einsum (grouped:
+    block-diagonal [K,So,G,Cin/G] x [K,Cin/G,G,Cout/G]) — FLOPs scale
+    with active sites, not volume.  Jit-safe via static-capacity site
+    tables (round 5); only int32 key overflow falls back to the
+    dense-masked formulation."""
 
     _subm = False
 
     def __init__(self, in_channels, out_channels, kernel_size, stride=1,
                  padding=0, dilation=1, groups=1, bias_attr=None,
-                 data_format="NDHWC"):
+                 data_format="NDHWC", static_out_capacity=None):
         super().__init__()
+        # jit path only: cap for the padded output-site table of a
+        # STRIDED conv (default K*nnz — a true upper bound; smaller
+        # values trade memory for silent truncation, see _site_tables)
+        self.static_out_capacity = static_out_capacity
         k = (kernel_size,) * 3 if isinstance(kernel_size, int) \
             else tuple(kernel_size)
         bound = 1.0 / np.sqrt(in_channels * int(np.prod(k)))
@@ -192,13 +310,16 @@ class Conv3D(Layer):
         self.groups = groups
 
     def forward(self, x):
-        if self.groups == 1:
-            prep = _prep_sparse_conv(
-                _coo(x), self.weight._array.shape[:3], self.stride,
-                (self.padding,) * 3 if isinstance(self.padding, int)
-                else tuple(self.padding), self.dilation, self._subm)
-            if prep is not None:
-                return self._sparse_forward(x, prep)
+        b = _coo(x)
+        prep = _prep_sparse_conv(
+            b, self.weight._array.shape[:3], self.stride,
+            (self.padding,) * 3 if isinstance(self.padding, int)
+            else tuple(self.padding), self.dilation, self._subm,
+            sig=_sig_of(x), out_capacity=self.static_out_capacity,
+            site_capacity=getattr(x, "_site_capacity", None),
+            entry_valid=getattr(x, "_entry_valid", None))
+        if prep is not None:
+            return self._sparse_forward(x, prep)
         return self._dense_forward(x)
 
     def _sparse_forward(self, x, prep):
@@ -209,16 +330,30 @@ class Conv3D(Layer):
         Cin = b.shape[-1]
         Cout = self.weight._array.shape[-1]
         kd, kh, kw = self.weight._array.shape[:3]
+        K = kd * kh * kw
+        G = self.groups
         S, rank, ch = prep["S"], prep["rank"], prep["ch"]
-        jall, hall = prep["jall"], prep["hall"]
+        jall, hall, out_valid = prep["jall"], prep["hall"], prep["out_valid"]
 
         def fn(vals, w, bias=None):
             feat = jnp.zeros((S, Cin), vals.dtype).at[rank, ch].add(vals)
             g = feat[jall] * hall[..., None].astype(vals.dtype)
-            out = jnp.einsum("ksi,kio->so", g,
-                             w.reshape(kd * kh * kw, Cin, Cout))
+            So_ = g.shape[1]              # output sites (= S only if subm)
+            if G == 1:
+                out = jnp.einsum("ksi,kio->so", g,
+                                 w.reshape(K, Cin, Cout))
+            else:
+                # block-diagonal contraction: group g's Cin/G inputs only
+                # meet its own Cout/G outputs (weight layout
+                # [*k, Cin/G, Cout] with output channels group-major)
+                gg = g.reshape(K, So_, G, Cin // G)
+                wg = w.reshape(K, Cin // G, G, Cout // G)
+                out = jnp.einsum("ksgi,kigo->sgo", gg,
+                                 wg).reshape(So_, Cout)
             if bias is not None:
                 out = out + bias
+            # static-capacity mode: padded output rows -> exact zeros
+            out = out * out_valid[:, None].astype(out.dtype)
             return out.reshape(-1)        # [So * Cout]
 
         ins = [x.values() if b.data.ndim == 1
@@ -230,17 +365,35 @@ class Conv3D(Layer):
 
         sites = prep["out_sites"]
         So = sites.shape[0]
+        # padded rows: clip coordinates into range (their values are 0, so
+        # the duplicate explicit zeros cannot change any dense read)
+        Do, Ho, Wo = prep["out_dims"]
+        lims = jnp.asarray([N - 1, Do - 1, Ho - 1, Wo - 1], sites.dtype)
+        sites = jnp.clip(sites, 0, lims[None, :])
         out_idx = jnp.concatenate(
             [jnp.repeat(sites, Cout, axis=0),
              jnp.tile(jnp.arange(Cout, dtype=sites.dtype),
                       So)[:, None]], axis=1)
-        Do, Ho, Wo = prep["out_dims"]
-        return SparseCooTensor(jsparse.BCOO(
+        out = SparseCooTensor(jsparse.BCOO(
             (vals_t._array, out_idx), shape=(N, Do, Ho, Wo, Cout)),
             values_t=vals_t)
+        if self._subm:
+            # submanifold: output site pattern == input pattern — share
+            # the site-table cache with downstream layers
+            out._site_sig = _sig_of(x)
+        # true bound on the output's unique sites (So rows, padded or
+        # not) — keeps a downstream conv's static capacity from growing
+        # to So * Cout (its nnz)
+        out._site_capacity = So
+        if _is_tracing(b):
+            # static mode: mark which entries are real so downstream BN /
+            # convs can mask the padding (values layout is site-major)
+            out._entry_valid = jnp.repeat(out_valid, Cout)
+        return out
 
     def _dense_forward(self, x):
-        """Dense-masked fallback (groups>1, int32 key overflow)."""
+        """Dense-masked fallback (int32 key overflow only — groups>1
+        runs sparse via the block-diagonal einsum since round 5)."""
         from ..ops import dispatch as ops
         from ..autograd import engine
         dense = _coo(x).todense()
