@@ -20,6 +20,17 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
+def _psum(x, axis_name):
+    """psum with a CPU-backend workaround: XLA CPU's AllReducePromotion
+    pass crashes cloning a bf16 all-reduce inside these schedules'
+    while/cond nests (checked jax 0.8/XLA mid-2026) — promote around it.
+    On TPU this is the plain bf16 psum (no extra converts)."""
+    if (hasattr(x, "dtype") and x.dtype == jnp.bfloat16
+            and jax.default_backend() == "cpu"):
+        return lax.psum(x.astype(jnp.float32),
+                        axis_name).astype(jnp.bfloat16)
+    return lax.psum(x, axis_name)
+
 def gpipe_spmd(stage_fn, n_stages, n_microbatches, axis_name="pp"):
     """Build the per-device pipelined function.
 
@@ -62,7 +73,7 @@ def gpipe_spmd(stage_fn, n_stages, n_microbatches, axis_name="pp"):
         (state, out_buf), _ = lax.scan(body, (state, out_buf),
                                        jnp.arange(T))
         # out_buf only valid on the last stage; broadcast via masked psum
-        out = lax.psum(
+        out = _psum(
             jnp.where(idx == P_ - 1, out_buf,
                       jnp.zeros_like(out_buf)), axis_name)
         return out[None]  # restore the leading pp axis for shard_map out_spec
@@ -153,7 +164,7 @@ def gpipe_hybrid(block_apply, n_stages, n_microbatches, axis_name="pp",
 
         (state, out_buf, aux_acc, bstack), _ = lax.scan(
             body, (state, out_buf, aux_acc, my_bufs), jnp.arange(T))
-        out = lax.psum(
+        out = _psum(
             jnp.where(idx == P_ - 1, out_buf,
                       jnp.zeros_like(out_buf)), axis_name)
         aux_total = lax.psum(aux_acc, axis_name)
@@ -282,7 +293,7 @@ def interleaved_hybrid(block_apply, n_stages, n_microbatches, n_chunks,
 
         (state, out_buf, fifo, aux_acc, bufs), _ = lax.scan(
             body, (state, out_buf, fifo, aux_acc, my_bufs), jnp.arange(T))
-        out = lax.psum(
+        out = _psum(
             jnp.where(idx == P_ - 1, out_buf,
                       jnp.zeros_like(out_buf)), axis_name)
         aux_total = lax.psum(aux_acc, axis_name)
@@ -425,7 +436,7 @@ def onef1b_pipeline(block_apply, mesh, n_stages, n_microbatches,
         (state, out_buf, in_store, aux_acc, bstack), _ = lax.scan(
             body, (state, out_buf, in_store, aux_acc, my_bufs),
             jnp.arange(T))
-        out = lax.psum(
+        out = _psum(
             jnp.where(idx == P_ - 1, out_buf, jnp.zeros_like(out_buf)),
             axis_name)
         aux_total = lax.psum(aux_acc, axis_name)
@@ -462,18 +473,24 @@ def onef1b_pipeline(block_apply, mesh, n_stages, n_microbatches,
                                         my_bufs)
                 return y, aux
 
-            def run_bwd():
+            # the accumulator rides THROUGH the cond: each branch returns
+            # the updated gacc.  Buffer-assignment dumps at 2.7B scale
+            # show ONE param-sized accumulator either way (XLA aliases
+            # the scan carry and fuses the add in place); this form makes
+            # that aliasing structural rather than an optimization the
+            # compiler has to find (docs/pp_memory.md).
+            def run_bwd(gacc_):
                 (y, _aux), vjp_fn = jax.vjp(f, my_params, x_in)
-                return vjp_fn((g_in.astype(y.dtype),
-                               daux.astype(jnp.float32)))
+                dparams, dx = vjp_fn((g_in.astype(y.dtype),
+                                      daux.astype(jnp.float32)))
+                gacc_ = jax.tree_util.tree_map(
+                    lambda a, d: a + d.astype(a.dtype), gacc_, dparams)
+                return gacc_, dx
 
-            def skip_bwd():   # bubble step: no recompute, no vjp FLOPs
-                return (jax.tree_util.tree_map(jnp.zeros_like, my_params),
-                        jnp.zeros_like(x_in))
+            def skip_bwd(gacc_):  # bubble step: no recompute, no vjp FLOPs
+                return gacc_, jnp.zeros_like(x_in)
 
-            dparams, dx = lax.cond(active, run_bwd, skip_bwd)
-            gacc = jax.tree_util.tree_map(
-                lambda a, d: a + d.astype(a.dtype), gacc, dparams)
+            gacc, dx = lax.cond(active, run_bwd, skip_bwd, gacc)
             prev_dx = lax.dynamic_index_in_dim(dx_buf, m, 0, keepdims=False)
             dx_buf = lax.dynamic_update_index_in_dim(
                 dx_buf, jnp.where(active & (idx == 0),
@@ -485,7 +502,7 @@ def onef1b_pipeline(block_apply, mesh, n_stages, n_microbatches,
         (gstate, gacc, dx_buf), _ = lax.scan(
             body, (gstate, gacc, dx_buf), jnp.arange(T))
         # dL/dx_mb is stage 0's dx wave; replicate it (x_mb rode in P())
-        dx_mb = lax.psum(
+        dx_mb = _psum(
             jnp.where(idx == 0, dx_buf, jnp.zeros_like(dx_buf)), axis_name)
         if my_bufs:    # buffers are non-differentiable: zero cotangents
             gacc = {**gacc,
@@ -592,7 +609,7 @@ def onef1b_interleaved(block_apply, mesh, n_stages, n_microbatches,
         (state, out_buf, in_store, fifo, aux_acc, bstack), _ = lax.scan(
             body, (state, out_buf, in_store, fifo, aux_acc, my_bufs),
             jnp.arange(T))
-        out = lax.psum(
+        out = _psum(
             jnp.where(idx == P_ - 1, out_buf, jnp.zeros_like(out_buf)),
             axis_name)
         aux_total = lax.psum(aux_acc, axis_name)
@@ -642,21 +659,23 @@ def onef1b_interleaved(block_apply, mesh, n_stages, n_microbatches,
                     jax.random.fold_in(key_d, vb * M + m), cb)
                 return y, aux
 
-            def run_bwd():
+            # accumulate INSIDE the cond (same aliasing rationale as
+            # onef1b_pipeline: no scan-level full-size dparams temp)
+            def run_bwd(gacc_):
                 (y, _aux), vjp_fn = jax.vjp(f, cp, x_in)
-                return vjp_fn((g_in.astype(y.dtype),
-                               daux.astype(jnp.float32)))
+                dcp, dx = vjp_fn((g_in.astype(y.dtype),
+                                  daux.astype(jnp.float32)))
+                grows = _chunk(gacc_, vb, lpc)
+                gacc_ = _chunk_put(
+                    gacc_, jax.tree_util.tree_map(
+                        lambda a, d: a + d.astype(a.dtype), grows, dcp),
+                    vb, lpc)
+                return gacc_, dx
 
-            def skip_bwd():
-                return (jax.tree_util.tree_map(jnp.zeros_like, cp),
-                        jnp.zeros_like(x_in))
+            def skip_bwd(gacc_):
+                return gacc_, jnp.zeros_like(x_in)
 
-            dcp, dx = lax.cond(active, run_bwd, skip_bwd)
-            grows = _chunk(gacc, vb, lpc)
-            gacc = _chunk_put(
-                gacc, jax.tree_util.tree_map(
-                    lambda a, d: a + d.astype(a.dtype), grows, dcp),
-                vb, lpc)
+            gacc, dx = lax.cond(active, run_bwd, skip_bwd, gacc)
             prev_dx = lax.dynamic_index_in_dim(dx_buf, m, 0,
                                                keepdims=False)
             is_dx = active & (idx == 0) & (vb == 0)
@@ -668,7 +687,7 @@ def onef1b_interleaved(block_apply, mesh, n_stages, n_microbatches,
 
         (gstate, gacc, dx_buf, gfifo), _ = lax.scan(
             body, (gstate, gacc, dx_buf, gfifo), jnp.arange(T))
-        dx_mb = lax.psum(
+        dx_mb = _psum(
             jnp.where(idx == 0, dx_buf, jnp.zeros_like(dx_buf)), axis_name)
         if my_bufs:
             gacc = {**gacc,

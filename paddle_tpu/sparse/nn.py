@@ -97,7 +97,7 @@ class BatchNorm(Layer):
             out = jnp.where(valid, out, 0.0)
         res = SparseCooTensor(jsparse.BCOO((out, b.indices),
                                            shape=b.shape))
-        res._site_sig = _sig_of(x)        # pattern-preserving
+        _sig_of(x)   # ensure x carries a sig for the helper to propagate
         return _propagate_pattern(res, x)
 
 

@@ -35,7 +35,21 @@ def main():
     ap.add_argument("--vocab", type=int, default=1024)
     ap.add_argument("--cpu", action="store_true",
                     help="force the virtual CPU mesh")
+    ap.add_argument("--preset", choices=["toy", "2.7b", "13b"],
+                    default="toy",
+                    help="toy: full sweep below; 2.7b/13b: region-only "
+                         "AOT probe at scale (ShapeDtypeStructs, no "
+                         "allocation; 13b adds mp=2 tensor parallel)")
+    ap.add_argument("--dtype", choices=["fp32", "bf16"], default="fp32",
+                    help="big-preset compute dtype.  fp32 is the "
+                         "apples-to-apples schedule measurement on the "
+                         "CPU backend; bf16 additionally carries XLA "
+                         "CPU's bf16->f32 dot-promotion temps (~2.1GB "
+                         "of weight converts at 2.7B) that do NOT "
+                         "exist on TPU")
     args = ap.parse_args()
+    if args.preset != "toy":
+        return big_region_probe(args)
 
     if args.cpu or "xla_force_host_platform_device_count" in \
             os.environ.get("XLA_FLAGS", ""):
@@ -169,6 +183,115 @@ def main():
     for sched, ms in region_rows:
         print(f"| {sched} | {ms.temp_size_in_bytes:,} "
               f"| {ms.temp_size_in_bytes / f1b_budget:.2%} |")
+
+
+def big_region_probe(args):
+    """Region-only (pipeline blocks fwd+bwd) AOT peak-memory at scale.
+
+    2.7b: GPT-2.7B-shaped blocks (H2560 L32 heads32), pp4, M8, mb1.
+    13b:  LLaMA-13B-shaped blocks (H5120 L40 heads40), pp4 x mp2, M8,
+          mb1 — Megatron-style column/row sharding of the block weights
+          via GSPMD inside the partial-manual pp shard_map.
+
+    Everything is ShapeDtypeStructs — nothing is allocated; the numbers
+    come from XLA buffer assignment (CompiledMemoryStats) on the virtual
+    CPU mesh.  On this backend a bf16 program additionally materializes
+    f32 copies of the weights around every dot (CPU has no native bf16
+    matmul); measure fp32 for the schedule comparison and read the TPU
+    bf16 estimate as fp32/2 (all dominant buffers scale with dtype
+    width; TPU MXUs consume bf16 directly, no convert temps).
+    """
+    import os
+    import re
+    mp = 2 if args.preset == "13b" else 1
+    P_ = 4
+    flags = os.environ.get("XLA_FLAGS", "")
+    if not re.search(r"--xla_force_host_platform_device_count=\d+", flags):
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={P_ * mp}"
+        ).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
+    from paddle_tpu.distributed.pipeline import (pipeline_apply_1f1b,
+                                                 pipeline_apply_hybrid)
+
+    if args.preset == "2.7b":
+        H, L, heads, ffn = 2560, 32, 32, 4 * 2560
+    else:
+        H, L, heads, ffn = 5120, 40, 40, 13824
+    S, M, mb = 1024, 8, 1
+    lps = L // P_
+    DT = jnp.float32 if args.dtype == "fp32" else jnp.bfloat16
+    bytes_per = 4 if args.dtype == "fp32" else 2
+
+    devs = np.array(jax.devices()[:P_ * mp]).reshape(P_, mp)
+    mesh = Mesh(devs, ("pp", "mp"))
+
+    def block(params, h, key):
+        hn = (h - h.mean(-1, keepdims=True)) / (
+            h.std(-1, keepdims=True) + 1e-5)
+        qkv = hn @ params["wqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        B_, L_, _ = q.shape
+        hd = H // heads
+        q = q.reshape(B_, L_, heads, hd)
+        k = k.reshape(B_, L_, heads, hd)
+        v = v.reshape(B_, L_, heads, hd)
+        s = jnp.einsum("blhd,bmhd->bhlm", q, k) / (hd ** 0.5)
+        mask = jnp.tril(jnp.ones((L_, L_), bool))
+        s = jnp.where(mask[None, None], s, jnp.asarray(-1e9, s.dtype))
+        a = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(DT)
+        o = jnp.einsum("bhlm,bmhd->blhd", a, v).reshape(B_, L_, H)
+        h = h + o @ params["wo"]
+        hn2 = (h - h.mean(-1, keepdims=True)) / (
+            h.std(-1, keepdims=True) + 1e-5)
+        h = h + jax.nn.gelu(hn2 @ params["w1"]) @ params["w2"]
+        return h, jnp.zeros((), jnp.float32)
+
+    shapes = {"wqkv": (H, 3 * H), "wo": (H, H),
+              "w1": (H, ffn), "w2": (ffn, H)}
+    # Megatron block sharding: qkv/w1 column-parallel, wo/w2 row-parallel
+    mp_specs = {"wqkv": PS("pp", None, None, "mp"),
+                "w1": PS("pp", None, None, "mp"),
+                "wo": PS("pp", None, "mp", None),
+                "w2": PS("pp", None, "mp", None)}
+    stacked = {n: jax.ShapeDtypeStruct((P_, lps) + sh, DT)
+               for n, sh in shapes.items()}
+    in_sh = ({n: NamedSharding(mesh, mp_specs[n]) for n in shapes},
+             NamedSharding(mesh, PS()), NamedSharding(mesh, PS()))
+    x_mb = jax.ShapeDtypeStruct((M, mb, S, H), DT)
+    k0 = jax.ShapeDtypeStruct((2,), jnp.uint32)
+
+    n_params = L * sum(int(np.prod(sh)) for sh in shapes.values())
+    act_budget = P_ * lps * 12 * mb * S * H * bytes_per
+    grad_buf = n_params // P_ // mp * bytes_per
+    print(f"# {args.preset} region probe: H{H} L{L} S{S} mb{mb} "
+          f"pp{P_} mp{mp} M{M} {args.dtype}  "
+          f"({n_params/1e9:.2f}B params)")
+    print(f"analytic 1F1B activation budget/device: {act_budget:,} B; "
+          f"grad accumulator/device: {grad_buf:,} B\n")
+    print("| schedule | temp bytes | vs act budget | est. TPU bf16 |")
+    print("|---|---|---|---|")
+    for sched in ("1F1B", "F-then-B"):
+        def loss(stacked_, x_, key_):
+            if sched == "1F1B":
+                y, aux = pipeline_apply_1f1b(
+                    jax.checkpoint(block), stacked_, x_, key_, mesh,
+                    n_stages=P_, n_microbatches=M)
+            else:
+                y, aux = pipeline_apply_hybrid(
+                    jax.checkpoint(block), stacked_, x_, key_, mesh,
+                    n_stages=P_, n_microbatches=M, n_chunks=1)
+            return jnp.sum((y * y).astype(jnp.float32)) + aux
+
+        g = jax.jit(jax.grad(loss), in_shardings=in_sh)
+        ms = g.lower(stacked, x_mb, k0).compile().memory_analysis()
+        t = ms.temp_size_in_bytes
+        est = t // 2 if args.dtype == "fp32" else t
+        print(f"| {sched} | {t:,} | {t / act_budget:.1%} | ~{est:,} |")
 
 
 if __name__ == "__main__":
