@@ -7,3 +7,5 @@ backprops to the waveform and runs under jit/the fused train step.
 """
 from . import functional  # noqa: F401
 from . import features  # noqa: F401
+from . import backends  # noqa: F401
+from .backends import info, load, save  # noqa: F401

@@ -1,0 +1,208 @@
+"""Audio I/O backends (reference: python/paddle/audio/backends — the
+``wave_backend`` load/save/info trio, with soundfile as an optional
+extra).
+
+This is a from-scratch RIFF/WAVE codec on numpy — no soundfile, no
+stdlib ``wave`` limitations: PCM 8/16/24/32-bit and IEEE float32/64,
+multi-channel, chunk-skipping parse (LIST/fact/cue chunks before
+``data`` are handled).  The decoded signal lands in a paddle Tensor so
+it feeds the feature layers / DataLoader directly.
+"""
+from __future__ import annotations
+
+import os
+import struct
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["AudioInfo", "info", "load", "save",
+           "list_available_backends", "get_current_backend",
+           "set_backend"]
+
+
+class AudioInfo:
+    """Container matching the reference's backend info record."""
+
+    def __init__(self, sample_rate, num_frames, num_channels,
+                 bits_per_sample, encoding):
+        self.sample_rate = sample_rate
+        self.num_frames = num_frames
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):
+        return (f"AudioInfo(sample_rate={self.sample_rate}, "
+                f"num_frames={self.num_frames}, "
+                f"num_channels={self.num_channels}, "
+                f"bits_per_sample={self.bits_per_sample}, "
+                f"encoding={self.encoding!r})")
+
+
+_PCM_DTYPES = {8: np.uint8, 16: np.int16, 32: np.int32}
+
+
+def _parse_riff(f):
+    """Walk the RIFF chunks; return (fmt dict, data offset, data size)."""
+    head = f.read(12)
+    if len(head) < 12 or head[:4] != b"RIFF" or head[8:12] != b"WAVE":
+        raise ValueError("not a RIFF/WAVE file")
+    fmt = None
+    while True:
+        hdr = f.read(8)
+        if len(hdr) < 8:
+            raise ValueError("no 'data' chunk found")
+        cid, size = hdr[:4], struct.unpack("<I", hdr[4:])[0]
+        if cid == b"fmt ":
+            raw = f.read(size)
+            (audio_format, n_channels, sample_rate, _byte_rate,
+             block_align, bits) = struct.unpack("<HHIIHH", raw[:16])
+            if audio_format == 0xFFFE and size >= 40:  # WAVE_FORMAT_EXTENSIBLE
+                audio_format = struct.unpack("<H", raw[24:26])[0]
+            fmt = dict(format=audio_format, channels=n_channels,
+                       rate=sample_rate, block_align=block_align,
+                       bits=bits)
+        elif cid == b"data":
+            if fmt is None:
+                raise ValueError("'data' chunk before 'fmt '")
+            return fmt, f.tell(), size
+        else:
+            f.seek(size + (size & 1), os.SEEK_CUR)  # chunks are word-aligned
+
+
+def info(filepath):
+    """Sample rate / frames / channels / bit depth / encoding."""
+    with open(filepath, "rb") as f:
+        fmt, _off, size = _parse_riff(f)
+    frames = size // max(fmt["block_align"], 1)
+    enc = {1: f"PCM_{['U','S'][fmt['bits'] > 8]}",
+           3: "PCM_F"}.get(fmt["format"])
+    if enc is None:
+        raise ValueError(f"unsupported WAVE format tag {fmt['format']}")
+    return AudioInfo(fmt["rate"], frames, fmt["channels"], fmt["bits"],
+                     f"{enc}{fmt['bits']}" if not enc.endswith("F")
+                     else f"PCM_F{fmt['bits']}")
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Decode a WAV file -> (Tensor, sample_rate).
+
+    normalize=True returns float32 in [-1, 1] regardless of the stored
+    encoding (the reference/torchaudio convention); normalize=False
+    returns the raw integer samples for PCM files.  channels_first
+    selects [C, T] (default) vs [T, C].
+    """
+    with open(filepath, "rb") as f:
+        fmt, off, size = _parse_riff(f)
+        f.seek(off)
+        raw = f.read(size)
+    C, bits, tag = fmt["channels"], fmt["bits"], fmt["format"]
+    if tag == 3:                                 # IEEE float
+        data = np.frombuffer(raw, np.float32 if bits == 32
+                             else np.float64).astype(np.float32)
+    elif tag == 1 and bits == 24:                # packed 3-byte PCM
+        b = np.frombuffer(raw, np.uint8).reshape(-1, 3)
+        data = ((b[:, 0].astype(np.int32))
+                | (b[:, 1].astype(np.int32) << 8)
+                | (b[:, 2].astype(np.int32) << 16))
+        data = (data ^ 0x800000) - 0x800000      # sign-extend 24 bits
+    elif tag == 1 and bits in _PCM_DTYPES:
+        data = np.frombuffer(raw, _PCM_DTYPES[bits]).astype(np.int32)
+        if bits == 8:
+            data = data - 128                    # WAV 8-bit is unsigned
+    else:
+        raise ValueError(f"unsupported WAVE encoding: tag {tag} "
+                         f"{bits}-bit")
+    data = data[:(len(data) // C) * C].reshape(-1, C)    # [T, C]
+    if frame_offset:
+        data = data[frame_offset:]
+    if num_frames is not None and num_frames >= 0:
+        data = data[:num_frames]
+    if normalize and tag == 1:
+        scale = float(2 ** (bits - 1) if bits > 8 else 128)
+        data = data.astype(np.float32) / scale
+    elif tag == 3:
+        data = data.astype(np.float32)
+    out = data.T if channels_first else data
+    return Tensor(jnp.asarray(np.ascontiguousarray(out))), fmt["rate"]
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_S", bits_per_sample=16):
+    """Encode a waveform Tensor/array to WAV.
+
+    encoding: "PCM_S" (8/16/24/32-bit signed; 8-bit stored unsigned per
+    the WAV spec) or "PCM_F" (float32).  Float input to a PCM encoding
+    is scaled from [-1, 1] and clipped, matching the reference.
+    """
+    arr = np.asarray(src.numpy() if isinstance(src, Tensor) else src)
+    if arr.ndim == 1:
+        arr = arr[None, :] if channels_first else arr[:, None]
+    data = arr.T if channels_first else arr      # -> [T, C]
+    C = data.shape[1]
+    if encoding == "PCM_F":
+        bits = 32
+        payload = data.astype(np.float32).tobytes()
+        tag = 3
+    elif encoding == "PCM_S":
+        bits = bits_per_sample
+        if np.issubdtype(data.dtype, np.floating):
+            # quantize in float64: full-1 = 2**31-1 is not a float32
+            # value, so a float32 clip would overflow int32 at +1.0 FS
+            full = float(2 ** (bits - 1))
+            q = np.clip(np.round(data.astype(np.float64) * full),
+                        -full, full - 1)
+        else:
+            q = data
+        tag = 1
+        if bits == 16:
+            payload = q.astype(np.int16).tobytes()
+        elif bits == 32:
+            payload = q.astype(np.int32).tobytes()
+        elif bits == 8:
+            payload = (q.astype(np.int32) + 128).astype(np.uint8).tobytes()
+        elif bits == 24:
+            q = q.astype(np.int32)
+            b = np.empty((q.size, 3), np.uint8)
+            flat = q.reshape(-1)
+            b[:, 0] = flat & 0xFF
+            b[:, 1] = (flat >> 8) & 0xFF
+            b[:, 2] = (flat >> 16) & 0xFF
+            payload = b.tobytes()
+        else:
+            raise ValueError(f"bits_per_sample={bits} unsupported")
+    else:
+        raise ValueError(f"encoding {encoding!r} unsupported")
+    block_align = C * bits // 8
+    hdr = struct.pack(
+        "<4sI4s4sIHHIIHH4sI", b"RIFF", 36 + len(payload), b"WAVE",
+        b"fmt ", 16, tag, C, int(sample_rate),
+        int(sample_rate) * block_align, block_align, bits,
+        b"data", len(payload))
+    with open(filepath, "wb") as f:
+        f.write(hdr + payload)
+
+
+# ------------------------------------------------- backend registry shim
+_BACKEND = "wave_backend"
+
+
+def list_available_backends():
+    """Only the built-in numpy wave backend ships in this environment
+    (soundfile is not installed — documented in docs/api_coverage.md)."""
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return _BACKEND
+
+
+def set_backend(backend_name):
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"backend {backend_name!r} unavailable: only the built-in "
+            "wave_backend ships here (no soundfile in the environment)")

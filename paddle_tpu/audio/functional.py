@@ -8,12 +8,26 @@ import numpy as np
 from ..tensor import Tensor
 
 __all__ = ["get_window", "hz_to_mel", "mel_to_hz", "compute_fbank_matrix",
-           "power_to_db", "create_dct"]
+           "power_to_db", "create_dct", "fft_frequencies",
+           "mel_frequencies"]
 
 
 def get_window(window, win_length, fftbins=True, dtype="float32"):
-    """hann/hamming/blackman/bartlett/bohman/... window (periodic when
-    fftbins=True, matching scipy/the reference)."""
+    """Window function by name or (name, *params) tuple (periodic when
+    fftbins=True).  The reference's get_window reimplements
+    scipy.signal.get_window's catalogue — delegate to scipy when present
+    (exact parity incl. kaiser/taylor/tukey/nuttall/...), keep the
+    hand-rolled core set as the no-scipy fallback."""
+    try:
+        from scipy.signal import get_window as _sp_get_window
+    except ImportError:
+        _sp_get_window = None
+    if _sp_get_window is not None:
+        try:
+            w = _sp_get_window(window, win_length, fftbins=fftbins)
+            return Tensor(jnp.asarray(w, jnp.float32))
+        except ValueError:
+            pass   # alias names scipy doesn't know (rect/ones/hanning)
     n = win_length
     m = n if fftbins else n - 1
     t = np.arange(n) / max(m, 1)
@@ -114,6 +128,20 @@ def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
     from ..tensor_api import _t
     return _ops.call("audio_power_to_db", _t(spect), ref_value=ref_value,
                      amin=amin, top_db=top_db)
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """[n_fft//2 + 1] center frequencies of the rfft bins."""
+    return Tensor(jnp.asarray(
+        np.linspace(0, sr / 2.0, n_fft // 2 + 1), jnp.float32))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    """[n_mels] mel-spaced frequencies in Hz between f_min and f_max."""
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                       n_mels)
+    return Tensor(jnp.asarray(mel_to_hz(mels, htk), jnp.float32))
 
 
 def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):

@@ -58,6 +58,8 @@ def _namespaces(pt):
         ("paddle.static", pt.static), ("paddle.jit", pt.jit),
         ("paddle.amp", pt.amp), ("paddle.metric", pt.metric),
         ("paddle.audio", pt.audio),
+        ("paddle.audio.functional", pt.audio.functional),
+        ("paddle.audio.features", pt.audio.features),
         ("paddle.quantization", pt.quantization),
         ("paddle.utils", pt.utils), ("paddle.inference", pt.inference),
         ("paddle.autograd", pt.autograd), ("paddle.hapi", pt.hapi),
