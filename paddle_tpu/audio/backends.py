@@ -58,6 +58,7 @@ def _parse_riff(f):
         cid, size = hdr[:4], struct.unpack("<I", hdr[4:])[0]
         if cid == b"fmt ":
             raw = f.read(size)
+            f.seek(size & 1, os.SEEK_CUR)   # word-aligned chunks
             (audio_format, n_channels, sample_rate, _byte_rate,
              block_align, bits) = struct.unpack("<HHIIHH", raw[:16])
             if audio_format == 0xFFFE and size >= 40:  # WAVE_FORMAT_EXTENSIBLE
@@ -83,8 +84,7 @@ def info(filepath):
     if enc is None:
         raise ValueError(f"unsupported WAVE format tag {fmt['format']}")
     return AudioInfo(fmt["rate"], frames, fmt["channels"], fmt["bits"],
-                     f"{enc}{fmt['bits']}" if not enc.endswith("F")
-                     else f"PCM_F{fmt['bits']}")
+                     f"{enc}{fmt['bits']}")
 
 
 def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
@@ -144,6 +144,14 @@ def save(filepath, src, sample_rate, channels_first=True,
         arr = arr[None, :] if channels_first else arr[:, None]
     data = arr.T if channels_first else arr      # -> [T, C]
     C = data.shape[1]
+    if np.issubdtype(data.dtype, np.integer):
+        # integer input: interpret at ITS OWN bit width and re-quantize
+        # to the target (a bare astype would wrap modulo 2^bits when
+        # narrowing, e.g. int32 samples saved at the default 16-bit)
+        src_bits = data.dtype.itemsize * 8
+        if np.issubdtype(data.dtype, np.unsignedinteger):
+            data = data.astype(np.int64) - 2 ** (src_bits - 1)
+        data = data.astype(np.float64) / float(2 ** (src_bits - 1))
     if encoding == "PCM_F":
         bits = 32
         payload = data.astype(np.float32).tobytes()
@@ -178,13 +186,14 @@ def save(filepath, src, sample_rate, channels_first=True,
     else:
         raise ValueError(f"encoding {encoding!r} unsupported")
     block_align = C * bits // 8
+    pad = b"\x00" if len(payload) & 1 else b""   # RIFF word alignment
     hdr = struct.pack(
-        "<4sI4s4sIHHIIHH4sI", b"RIFF", 36 + len(payload), b"WAVE",
-        b"fmt ", 16, tag, C, int(sample_rate),
+        "<4sI4s4sIHHIIHH4sI", b"RIFF", 36 + len(payload) + len(pad),
+        b"WAVE", b"fmt ", 16, tag, C, int(sample_rate),
         int(sample_rate) * block_align, block_align, bits,
         b"data", len(payload))
     with open(filepath, "wb") as f:
-        f.write(hdr + payload)
+        f.write(hdr + payload + pad)
 
 
 # ------------------------------------------------- backend registry shim

@@ -268,3 +268,44 @@ class TestWavBackend:
         assert B.get_current_backend() == "wave_backend"
         with pytest.raises(NotImplementedError):
             B.set_backend("soundfile")
+
+
+def test_window_fallback_matches_scipy_path(monkeypatch):
+    """The no-scipy hand-rolled windows must track the scipy results so
+    a scipy-less deployment gets the same numerics for the core set."""
+    import sys
+    want = {name: AF.get_window(name, 24, fftbins=fb).numpy()
+            for name in ("hann", "hamming", "blackman", "bartlett",
+                         "bohman", "boxcar")
+            for fb in (True,)}
+    monkeypatch.setitem(sys.modules, "scipy.signal", None)
+    for name, ref in want.items():
+        got = AF.get_window(name, 24, fftbins=True).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-6, err_msg=name)
+
+
+def test_save_rescales_wide_integer_input(tmp_path):
+    """int32 samples saved at the default 16-bit must re-quantize, not
+    wrap modulo 2^16."""
+    from paddle_tpu import audio
+    t = np.arange(400) / 16000.0
+    x = np.sin(2 * np.pi * 440 * t).astype(np.float32)[None]
+    p1 = str(tmp_path / "a.wav")
+    audio.save(p1, x, 16000, bits_per_sample=32)
+    y32, _ = audio.load(p1, normalize=False)      # int32 near full scale
+    p2 = str(tmp_path / "b.wav")
+    audio.save(p2, y32, 16000, bits_per_sample=16)
+    y, _ = audio.load(p2)                          # normalized float
+    np.testing.assert_allclose(y.numpy(), x, atol=2e-4)
+
+
+def test_odd_payload_gets_riff_pad(tmp_path):
+    from paddle_tpu import audio
+    x = (np.sin(np.arange(101) / 5.0)).astype(np.float32)[None]
+    p = str(tmp_path / "odd.wav")
+    audio.save(p, x, 8000, bits_per_sample=8)      # 101-byte payload
+    import os as _os
+    size = _os.path.getsize(p)
+    assert size % 2 == 0                           # pad byte written
+    y, sr = audio.load(p)
+    assert tuple(y.shape) == (1, 101) and sr == 8000

@@ -60,6 +60,7 @@ def _namespaces(pt):
         ("paddle.audio", pt.audio),
         ("paddle.audio.functional", pt.audio.functional),
         ("paddle.audio.features", pt.audio.features),
+        ("paddle.audio.backends", pt.audio.backends),
         ("paddle.quantization", pt.quantization),
         ("paddle.utils", pt.utils), ("paddle.inference", pt.inference),
         ("paddle.autograd", pt.autograd), ("paddle.hapi", pt.hapi),
