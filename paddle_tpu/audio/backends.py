@@ -125,6 +125,19 @@ def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
     if normalize and tag == 1:
         scale = float(2 ** (bits - 1) if bits > 8 else 128)
         data = data.astype(np.float32) / scale
+    elif tag == 1:
+        # normalize=False: container dtype ENCODES the sample width so a
+        # later save() re-quantizes at the right full scale (8->int8,
+        # 16->int16, 24->int32 shifted to full scale per the soundfile
+        # convention, 32->int32)
+        if bits == 8:
+            data = data.astype(np.int8)
+        elif bits == 16:
+            data = data.astype(np.int16)
+        elif bits == 24:
+            data = (data << 8).astype(np.int32)
+        else:
+            data = data.astype(np.int32)
     elif tag == 3:
         data = data.astype(np.float32)
     out = data.T if channels_first else data
