@@ -84,8 +84,13 @@ class TrainStep:
             sync()            # before training eagerly from the model
         pn, pa, bn, ba = FB.split_state(model)
         if self._opt_state is None:
-            # adopt any state the optimizer already has; else init
-            self._opt_state = optimizer._state or optimizer.init_state(pa)
+            # adopt any state the optimizer already has; else init —
+            # frozen params (stop_gradient) get NO slots (empty dicts):
+            # a LoRA/linear-probe fine-tune must not pay optimizer HBM
+            # for the frozen base
+            frozen = [p.stop_gradient for _, p in model.named_parameters()]
+            self._opt_state = optimizer._state or optimizer.init_state(
+                pa, frozen=frozen)
             optimizer._state = None  # fused step owns the state now
         if self._jitted is None:
             self._build()

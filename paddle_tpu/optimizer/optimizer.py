@@ -92,15 +92,23 @@ class Optimizer:
         """Return dict slot->initial array for one param."""
         return {s: jnp.zeros_like(arr, dtype=jnp.float32) for s in self.SLOTS}
 
-    def init_state(self, param_arrays):
+    def init_state(self, param_arrays, frozen=None):
         # one jitted program for the WHOLE state tree: building slots
         # eagerly costs a device round-trip per zeros/cast, which on a
-        # tunneled TPU turns large-model setup into minutes
+        # tunneled TPU turns large-model setup into minutes.
+        # `frozen[i]` skips slot allocation entirely for parameters that
+        # will never be updated (stop_gradient — e.g. a LoRA fine-tune's
+        # base weights): update() passes empty slots through untouched,
+        # so a frozen 1.3B base costs ZERO optimizer HBM instead of two
+        # fp32 moments per weight.
         import jax
 
         def _build(arrs):
             state = []
-            for a in arrs:
+            for i, a in enumerate(arrs):
+                if frozen is not None and frozen[i]:
+                    state.append({})
+                    continue
                 slots = self._init_state_for(a)
                 if self._use_master_weights and a.dtype in (
                         jnp.bfloat16, jnp.float16):

@@ -25,3 +25,6 @@ from .viterbi import viterbi_decode, ViterbiDecoder  # noqa: F401
 from .transformer_mt import (  # noqa: F401
     TransformerModel, transformer_mt_loss, sinusoidal_positions,
 )
+from .peft import (  # noqa: F401
+    LoRAConfig, LoRAModel, LoRALinear, get_peft_model,
+)

@@ -1,0 +1,233 @@
+"""Parameter-efficient fine-tuning (reference analog: PaddleNLP's
+``paddlenlp.peft`` — LoRAConfig / LoRAModel over frozen base weights).
+
+TPU-native shape: the adapters are ordinary parameters, so the fused
+train step (forward+backward+optimizer in ONE donated XLA program)
+trains them with the base weights FROZEN via ``stop_gradient`` — the
+engine skips frozen parameters in its update AND allocates no
+optimizer slots for them (jit/train_step.py passes the frozen mask to
+``Optimizer.init_state``), so a LoRA fine-tune costs optimizer state
+and gradients only for the adapter ranks, not the base model.
+``merge()`` folds ``scale * A @ B`` into the base weight so serving
+pays zero adapter overhead (one XLA fusion anyway, but merged
+checkpoints interop with the plain model classes).  Adapter creation
+goes through ``create_parameter`` (LazyGuard-deferrable) and
+merge/unmerge batch every layer's delta into ONE jitted program — no
+per-layer round-trips on a tunneled TPU.
+"""
+from __future__ import annotations
+
+import re
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+
+
+class LoRAConfig:
+    """Subset of PaddleNLP's LoRAConfig that matters for the math:
+    rank r, alpha (scale = alpha / r), dropout on the adapter input,
+    and a target_modules list of regex patterns matched against
+    sublayer paths (e.g. ``[".*qkv_proj", ".*out_proj"]``)."""
+
+    def __init__(self, r=8, lora_alpha=16, lora_dropout=0.0,
+                 target_modules=(".*q_proj", ".*k_proj", ".*v_proj",
+                                 ".*qkv_proj"),
+                 trainable_bias=False):
+        if r < 1:
+            raise ValueError("LoRA rank must be >= 1")
+        self.r = int(r)
+        self.lora_alpha = float(lora_alpha)
+        self.lora_dropout = float(lora_dropout)
+        self.target_modules = list(target_modules)
+        self.trainable_bias = bool(trainable_bias)
+
+    def to_dict(self):
+        return dict(r=self.r, lora_alpha=self.lora_alpha,
+                    lora_dropout=self.lora_dropout,
+                    target_modules=self.target_modules,
+                    trainable_bias=self.trainable_bias)
+
+
+class LoRALinear(nn.Layer):
+    """A frozen Linear plus a rank-r residual: y = xW + b + s * (xA)B.
+
+    A is gaussian-initialized, B starts at ZERO, so the wrapped layer is
+    exactly the base layer at step 0 (the LoRA paper's init).  Weight
+    layout follows the reference Linear: W [in, out], A [in, r],
+    B [r, out]."""
+
+    def __init__(self, base, r, alpha, dropout=0.0):
+        super().__init__()
+        if not isinstance(base, nn.Linear):
+            raise TypeError(
+                f"LoRALinear wraps nn.Linear, got {type(base).__name__}")
+        from ..nn import initializer as I
+        self.base = base
+        self.r = r
+        self.scaling = alpha / r
+        self._dropout_p = dropout
+        fan_in = base.in_features
+        # create_parameter: LazyGuard-deferrable, so wrapping a large
+        # model under a guard materializes ALL adapters in one jit
+        self.lora_A = self.create_parameter(
+            [fan_in, r],
+            default_initializer=I.Normal(std=1.0 / np.sqrt(fan_in)))
+        self.lora_B = self.create_parameter(
+            [r, base.out_features], default_initializer=I.Constant(0.0))
+        self.merged = False
+
+    def forward(self, x):
+        y = self.base(x)
+        if self.merged:
+            return y
+        h = x
+        if self._dropout_p > 0.0 and self.training:
+            h = nn.functional.dropout(h, p=self._dropout_p)
+        return y + (h @ self.lora_A) @ self.lora_B * self.scaling
+
+    def merge(self):
+        """Fold the adapter into the base weight (serving path)."""
+        if self.merged:
+            return
+        delta = (self.lora_A._array @ self.lora_B._array) * self.scaling
+        self.base.weight._inplace_assign(
+            self.base.weight._array + delta.astype(
+                self.base.weight._array.dtype))
+        self.merged = True
+
+    def unmerge(self):
+        if not self.merged:
+            return
+        delta = (self.lora_A._array @ self.lora_B._array) * self.scaling
+        self.base.weight._inplace_assign(
+            self.base.weight._array - delta.astype(
+                self.base.weight._array.dtype))
+        self.merged = False
+
+    def extra_repr(self):
+        return (f"in={self.base.in_features}, "
+                f"out={self.base.out_features}, r={self.r}, "
+                f"scale={self.scaling}, merged={self.merged}")
+
+
+class LoRAModel(nn.Layer):
+    """Wrap ``model``: replace every Linear whose sublayer path matches a
+    target pattern with LoRALinear, freeze everything except the
+    adapters (+biases when config.trainable_bias), and expose
+    adapter-only state_dict save/load plus merge/unmerge."""
+
+    def __init__(self, model, lora_config):
+        super().__init__()
+        self.model = model
+        self.lora_config = lora_config
+        pats = [re.compile(p + "$") for p in lora_config.target_modules]
+        replaced = []
+        for path, sub in list(model.named_sublayers()):
+            if not isinstance(sub, nn.Linear):
+                continue
+            if not any(p.match(path) for p in pats):
+                continue
+            parent, leaf = self._resolve_parent(model, path)
+            wrapped = LoRALinear(sub, lora_config.r,
+                                 lora_config.lora_alpha,
+                                 lora_config.lora_dropout)
+            setattr(parent, leaf, wrapped)
+            replaced.append(path)
+        if not replaced:
+            raise ValueError(
+                f"no Linear matched target_modules="
+                f"{lora_config.target_modules}")
+        self.replaced = replaced
+        self._freeze()
+
+    @staticmethod
+    def _resolve_parent(model, path):
+        parts = path.split(".")
+        parent = model
+        for p in parts[:-1]:
+            parent = getattr(parent, p)
+        return parent, parts[-1]
+
+    def _freeze(self):
+        for name, p in self.model.named_parameters():
+            is_adapter = "lora_A" in name or "lora_B" in name
+            is_bias = name.endswith(".bias")
+            trainable = is_adapter or (is_bias
+                                       and self.lora_config.trainable_bias)
+            p.stop_gradient = not trainable
+
+    def forward(self, *args, **kwargs):
+        return self.model(*args, **kwargs)
+
+    def __getattr__(self, name):
+        # delegate model-specific helpers (generate, new_caches, ...)
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["model"], name)
+
+    # ----------------------------------------------------------- adapters
+    def trainable_parameters(self):
+        return [p for p in self.model.parameters() if not p.stop_gradient]
+
+    def adapter_state_dict(self):
+        return {n: p for n, p in self.model.named_parameters()
+                if "lora_A" in n or "lora_B" in n}
+
+    def save_adapter(self, path):
+        np.savez(path, **{n: np.asarray(p._array)
+                          for n, p in self.adapter_state_dict().items()})
+
+    def load_adapter(self, path):
+        data = np.load(path if str(path).endswith(".npz")
+                       else str(path) + ".npz")
+        own = self.adapter_state_dict()
+        missing = set(own) - set(data.files)
+        if missing:
+            raise KeyError(f"adapter file missing {sorted(missing)[:3]}")
+        for n, p in own.items():
+            p._inplace_assign(jnp.asarray(data[n]))
+
+    def merge(self):
+        """Fold every adapter into its base weight in ONE jitted program.
+
+        Compiled programs trace ``merged`` as a python constant, so a
+        train step compiled before merge() would ADD THE ADAPTER AGAIN
+        on top of the merged weight — refuse in training mode (call
+        ``.eval()`` first; rebuild the step if you resume training)."""
+        if self.training:
+            raise RuntimeError(
+                "merge() on a model in train mode: a previously compiled "
+                "train step would double-count the adapter against the "
+                "merged weight. Call .eval() first, and rebuild any "
+                "train step before resuming training.")
+        self._merge_all(+1.0)
+
+    def unmerge(self):
+        self._merge_all(-1.0)
+
+    def _merge_all(self, sign):
+        import jax
+        want_merged = sign > 0
+        subs = [s for s in self.model.sublayers()
+                if isinstance(s, LoRALinear) and s.merged != want_merged]
+        if not subs:
+            return
+        scales = [s.scaling * sign for s in subs]
+
+        def fused(tups):
+            return [w + (a @ b * sc).astype(w.dtype)
+                    for (w, a, b), sc in zip(tups, scales)]
+
+        outs = jax.jit(fused)([(s.base.weight._array, s.lora_A._array,
+                                s.lora_B._array) for s in subs])
+        for s, w in zip(subs, outs):
+            s.base.weight._inplace_assign(w)
+            s.merged = want_merged
+
+
+def get_peft_model(model, lora_config):
+    """PaddleNLP-style entry point."""
+    return LoRAModel(model, lora_config)

@@ -1,0 +1,146 @@
+"""LoRA fine-tuning (text/peft.py; reference analog: paddlenlp.peft).
+
+Pinned: zero-init exactness at step 0, frozen-base training through the
+fused step (base weights bit-identical after training, adapters moved),
+merge/unmerge exactness, adapter-only save/load round-trip, and helper
+delegation (generate through the wrapper).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+from paddle_tpu.text import GPTConfig, GPTForCausalLM, gpt_loss_fn
+from paddle_tpu.text.peft import (LoRAConfig, LoRAModel, LoRALinear,
+                                  get_peft_model)
+
+
+def _gpt(seed=0):
+    pt.seed(seed)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, max_position_embeddings=32,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    tensor_parallel=False)
+    return GPTForCausalLM(cfg)
+
+
+def _snapshot(model, key):
+    return {n: np.asarray(p._array).copy()
+            for n, p in model.named_parameters() if key(n)}
+
+
+class TestLoRA:
+    def test_zero_init_is_identity(self):
+        base = _gpt()
+        ids = pt.randint(0, 64, [2, 8])
+        want = base(ids).numpy()
+        lora = get_peft_model(base, LoRAConfig(r=4))
+        got = lora(ids).numpy()
+        np.testing.assert_array_equal(got, want)   # B starts at zero
+        assert len(lora.replaced) == 2             # qkv_proj per layer
+
+    def test_trainable_surface_is_adapters_only(self):
+        lora = LoRAModel(_gpt(), LoRAConfig(
+            r=4, target_modules=[".*qkv_proj", ".*out_proj"]))
+        train = lora.trainable_parameters()
+        total = list(lora.model.parameters())
+        n_train = sum(p.size for p in train)
+        n_total = sum(p.size for p in total)
+        # toy dims make the ratio generous; at real width it is ~0.1%
+        assert n_train < 0.10 * n_total
+        names = dict(lora.model.named_parameters())
+        for n, p in names.items():
+            is_adapter = "lora_" in n
+            assert p.stop_gradient != is_adapter, n
+
+    def test_fused_step_trains_adapters_freezes_base(self):
+        lora = LoRAModel(_gpt(3), LoRAConfig(r=4, lora_alpha=8))
+        base_before = _snapshot(lora.model, lambda n: "lora_" not in n)
+        opt = pt.optimizer.AdamW(learning_rate=3e-2,
+                                 parameters=lora.trainable_parameters())
+        step = pt.jit.train_step(lora, gpt_loss_fn, opt)
+        ids = pt.randint(0, 64, [4, 16])
+        labels = pt.randint(0, 64, [4, 16])
+        losses = [float(step(ids, labels)) for _ in range(25)]
+        assert losses[-1] < losses[0] - 0.3, losses
+        base_after = _snapshot(lora.model, lambda n: "lora_" not in n)
+        for n in base_before:   # frozen: BIT-identical through the step
+            np.testing.assert_array_equal(base_before[n], base_after[n],
+                                          err_msg=n)
+        ad = _snapshot(lora.model, lambda n: "lora_B" in n)
+        assert any(np.abs(v).sum() > 0 for v in ad.values())
+
+    def test_merge_unmerge_exact(self):
+        lora = LoRAModel(_gpt(5), LoRAConfig(r=4))
+        # give the adapters nonzero weights
+        for n, p in lora.adapter_state_dict().items():
+            pt.seed(hash(n) % 1000)
+            p._inplace_assign(0.02 * pt.randn(list(p.shape))._array)
+        ids = pt.randint(0, 64, [2, 8])
+        want = lora(ids).numpy()
+        w0 = _snapshot(lora.model, lambda n: n.endswith("base.weight"))
+        # merge() refuses in train mode (a compiled step would
+        # double-count the adapter) — that guard is part of the contract
+        with pytest.raises(RuntimeError, match="train mode"):
+            lora.merge()
+        lora.eval()
+        lora.merge()
+        got = lora(ids).numpy()
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+        lora.unmerge()
+        np.testing.assert_allclose(lora(ids).numpy(), want, rtol=2e-5,
+                                   atol=2e-5)
+        w1 = _snapshot(lora.model, lambda n: n.endswith("base.weight"))
+        for n in w0:
+            np.testing.assert_allclose(w0[n], w1[n], rtol=1e-5,
+                                       atol=1e-6, err_msg=n)
+
+    def test_adapter_save_load_roundtrip(self, tmp_path):
+        lora = LoRAModel(_gpt(7), LoRAConfig(r=2))
+        for n, p in lora.adapter_state_dict().items():
+            pt.seed(hash(n) % 997)
+            p._inplace_assign(0.05 * pt.randn(list(p.shape))._array)
+        ids = pt.randint(0, 64, [2, 8])
+        want = lora(ids).numpy()
+        path = str(tmp_path / "adapter")
+        lora.save_adapter(path)
+        fresh = LoRAModel(_gpt(7), LoRAConfig(r=2))
+        assert not np.allclose(fresh(ids).numpy(), want)
+        fresh.load_adapter(path)
+        np.testing.assert_allclose(fresh(ids).numpy(), want, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_generate_delegates_through_wrapper(self):
+        from paddle_tpu.text.generation import generate
+        lora = LoRAModel(_gpt(9), LoRAConfig(r=2))
+        lora.eval()
+        ids = pt.randint(0, 64, [1, 6])
+        out = generate(lora, ids, max_new_tokens=4)
+        assert tuple(out.shape) == (1, 10)
+
+    def test_no_match_raises(self):
+        with pytest.raises(ValueError, match="no Linear matched"):
+            LoRAModel(_gpt(), LoRAConfig(target_modules=["nope.*"]))
+
+    def test_wrap_non_linear_raises(self):
+        with pytest.raises(TypeError, match="wraps nn.Linear"):
+            LoRALinear(pt.nn.LayerNorm(8), 4, 8)
+
+
+def test_frozen_params_get_no_optimizer_state():
+    """The fused step must not allocate moments/master for frozen base
+    weights — a LoRA fine-tune's optimizer HBM is adapter-sized."""
+    lora = LoRAModel(_gpt(11), LoRAConfig(r=2))
+    opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=lora.trainable_parameters())
+    step = pt.jit.train_step(lora, gpt_loss_fn, opt)
+    ids = pt.randint(0, 64, [2, 8])
+    float(step(ids, ids))
+    names = [n for n, _ in lora.named_parameters()]
+    state = step._opt_state
+    assert len(state) == len(names)
+    for n, slots in zip(names, state):
+        if "lora_" in n:
+            assert slots, n                    # adapters: real moments
+        else:
+            assert slots == {}, n              # frozen: zero HBM

@@ -6,11 +6,12 @@ Usage (chip-side, run the moment a claim window opens):
         [--batch 4] [--steps 6] [--trace]
 
 Prints, per variant: measured step time, tokens/s, MFU vs the v5e's
-197 TFLOP/s bf16 peak, the XLA-counted FLOPs (so the 6N estimate can be
-cross-checked), and the compiled temp/arg bytes (donation audit: args
-should be ~= params + opt state ONCE — a second param-sized temp means
-donation is broken).  --trace additionally captures a jax.profiler
-trace into bench_results/trace_<preset>/ for op-level attribution.
+197 TFLOP/s bf16 peak, and the device's live/peak HBM next to the
+param footprint (donation audit: with donation working, peak ~= params
++ opt state + activations; a second param-sized plateau on top means
+donate_argnums regressed).  --trace additionally captures a
+jax.profiler trace into bench_results/trace_<preset>/ for op-level
+attribution.
 
 Variants swept (cheap, one compile each): pallas flash attention ON
 (default) vs OFF — the override gate is decided at import time, so the
@@ -130,8 +131,15 @@ def main():
                "--batch", str(args.batch), "--steps", str(args.steps)]
         if args.trace and pallas == "1":
             cmd.append("--trace")
-        r = subprocess.run(cmd, env=env, capture_output=True, text=True,
-                           timeout=2400)
+        try:
+            r = subprocess.run(cmd, env=env, capture_output=True,
+                               text=True, timeout=2400)
+        except subprocess.TimeoutExpired:
+            # fail open: the other variant still runs, the sweep still
+            # prints one line per leg (a burned chip window must never
+            # yield zero output)
+            print(f"pallas={pallas}: FAILED :: timeout after 2400s")
+            continue
         for line in r.stdout.splitlines():
             if line.startswith("MFU_RESULT "):
                 res = json.loads(line[len("MFU_RESULT "):])
