@@ -399,23 +399,30 @@ def cosine_similarity(x1, x2, axis=1, eps=1e-8):
 # --------------------------------------------------------------- attention
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
-                                 training=True, scale=None):
+                                 training=True, scale=None,
+                                 sliding_window=None):
     """(B, L, H, D) layout. Dispatches to the pallas flash kernel on TPU via
     the op registry override; XLA reference path otherwise."""
     q, k, v = _t(query), _t(key), _t(value)
+    if sliding_window and not is_causal:
+        # one contract across backends: the pallas kernel refuses this
+        # combination, so the XLA path must not silently ignore the band
+        raise ValueError("sliding_window requires is_causal=True")
     if attn_mask is not None:
         m = _t(attn_mask)
         # a TRAINED additive mask (ALiBi-style bias) must take the XLA
         # path: the flash kernel does not produce mask gradients
         out = ops.call("sdpa", q, k, v, m,
                        is_causal=is_causal, scale=scale,
+                       sliding_window=sliding_window,
                        _mask_needs_grad=not m.stop_gradient)
     else:
         from ..autograd import engine
         out = engine.apply(
             "sdpa",
             lambda q_, k_, v_, **kw: ops.call_raw("sdpa", q_, k_, v_, None, **kw),
-            [q, k, v], {"is_causal": is_causal, "scale": scale})
+            [q, k, v], {"is_causal": is_causal, "scale": scale,
+                        "sliding_window": sliding_window})
     if dropout_p > 0.0 and training:
         out = dropout(out, dropout_p, training=training)
     return out

@@ -328,7 +328,7 @@ def embedding_k(w, ids, padding_idx=None):
 # --------------------------------------------------------------- attention
 @register("sdpa", amp="allow")
 def sdpa_k(q, k, v, mask=None, is_causal=False, scale=None,
-           _mask_needs_grad=False):
+           sliding_window=None, _mask_needs_grad=False):
     """Scaled dot-product attention, (B, L, H, D) layout like the reference's
     nn.functional.scaled_dot_product_attention. Softmax in fp32.
     GQA: fewer kv heads are repeat_interleave-broadcast up to q heads (the
@@ -346,6 +346,10 @@ def sdpa_k(q, k, v, mask=None, is_causal=False, scale=None,
     if is_causal:
         lq, lk = scores.shape[-2], scores.shape[-1]
         cm = jnp.tril(jnp.ones((lq, lk), bool), lk - lq)
+        if sliding_window:
+            # banded causal (Mistral SWA): col in (r+off-W, r+off]
+            cm &= jnp.triu(jnp.ones((lq, lk), bool),
+                           lk - lq - int(sliding_window) + 1)
         scores = jnp.where(cm, scores, -jnp.inf)
     if mask is not None:
         if mask.dtype == jnp.bool_:

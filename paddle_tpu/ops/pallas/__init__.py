@@ -41,15 +41,17 @@ _xla_sdpa = get("sdpa").fn
 
 
 def sdpa_with_flash(q, k, v, mask=None, is_causal=False, scale=None,
-                    _mask_needs_grad=False):
+                    sliding_window=None, _mask_needs_grad=False):
     mode = _mode()
     if mode is not None and not _mask_needs_grad and \
+            (not sliding_window or is_causal) and \
             _fa.supports(q.shape, k.shape, mask, q.dtype,
                          v_shape=v.shape, is_causal=is_causal):
         return _fa.flash_attention(q, k, v, mask=mask, is_causal=is_causal,
-                                   scale=scale,
+                                   scale=scale, window=sliding_window,
                                    interpret=(mode == "interpret"))
-    return _xla_sdpa(q, k, v, mask=mask, is_causal=is_causal, scale=scale)
+    return _xla_sdpa(q, k, v, mask=mask, is_causal=is_causal, scale=scale,
+                     sliding_window=sliding_window)
 
 
 override("sdpa", sdpa_with_flash)

@@ -82,7 +82,7 @@ def _pad_to(n, b):
 
 # ------------------------------------------------------------------ forward
 def _fwd_kernel(*refs, scale, causal, off, bq, bk, nk, has_mask,
-                mask_rows, lk_real):
+                mask_rows, lk_real, window):
     if has_mask:
         q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
     else:
@@ -103,6 +103,9 @@ def _fwd_kernel(*refs, scale, causal, off, bq, bk, nk, has_mask,
     # matching sdpa_k's jnp.tril(..., lk - lq)
     run = (q_start + bq + off > k_start) if causal else (ik >= 0)
     run = jnp.logical_and(run, k_start < lk_real)  # skip all-pad blocks
+    if window:  # sliding window: skip blocks entirely left of the band
+        run = jnp.logical_and(run,
+                              k_start + bk - 1 > q_start + off - window)
 
     @pl.when(run)
     def _body():
@@ -115,6 +118,8 @@ def _fwd_kernel(*refs, scale, causal, off, bq, bk, nk, has_mask,
         if causal:
             rows = q_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             keep = jnp.logical_and(keep, rows + off >= cols)
+            if window:  # attend cols in (r+off-window, r+off]
+                keep = jnp.logical_and(keep, cols > rows + off - window)
         s = jnp.where(keep, s, _NEG_INF)
         if has_mask:
             m = mask_ref[0].astype(jnp.float32)   # (bq|1, bk) additive
@@ -176,7 +181,7 @@ def _mask_index(mask_meta, H):
 
 
 def _fwd(q, k, v, mask, causal, scale, bq, bk, interpret, H, Hkv, mask_meta,
-         lk_real):
+         lk_real, window=0):
     mask_meta = dict(mask_meta)
     BH, Lq, D = q.shape
     Lk = k.shape[1]
@@ -186,7 +191,7 @@ def _fwd(q, k, v, mask, causal, scale, bq, bk, interpret, H, Hkv, mask_meta,
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, off=mask_meta["off"],
         bq=bq, bk=bk, nk=nk, has_mask=has_mask, mask_rows=mask_rows,
-        lk_real=lk_real)
+        lk_real=lk_real, window=window)
     kvi = _kv_index(H, Hkv)
     in_specs = [
         pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
@@ -233,7 +238,7 @@ def _fwd(q, k, v, mask, causal, scale, bq, bk, interpret, H, Hkv, mask_meta,
 
 # ----------------------------------------------------------------- backward
 def _bwd_p(q, k, lse, mask_blk, scale, causal, off, q_start, k_start, bq, bk,
-           mask_rows, lk_real):
+           mask_rows, lk_real, window):
     """Recompute p = exp(s - lse) for one block of the backward sweeps."""
     s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                         preferred_element_type=jnp.float32) * scale
@@ -242,6 +247,8 @@ def _bwd_p(q, k, lse, mask_blk, scale, causal, off, q_start, k_start, bq, bk,
     if causal:
         rows = q_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         keep = jnp.logical_and(keep, rows + off >= cols)
+        if window:
+            keep = jnp.logical_and(keep, cols > rows + off - window)
     s = jnp.where(keep, s, _NEG_INF)
     if mask_blk is not None:
         m = mask_blk.astype(jnp.float32)
@@ -253,7 +260,7 @@ def _bwd_p(q, k, lse, mask_blk, scale, causal, off, q_start, k_start, bq, bk,
 
 
 def _dkv_kernel(*refs, scale, causal, off, bq, bk, nq, g, has_mask,
-                mask_rows, lk_real):
+                mask_rows, lk_real, window):
     if has_mask:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
          dk_ref, dv_ref, dk_s, dv_s) = refs
@@ -273,6 +280,9 @@ def _dkv_kernel(*refs, scale, causal, off, bq, bk, nq, g, has_mask,
     k_start = jk * bk
     run = (q_start + bq + off > k_start) if causal else (iq >= 0)
     run = jnp.logical_and(run, k_start < lk_real)
+    if window:
+        run = jnp.logical_and(run,
+                              k_start + bk - 1 > q_start + off - window)
 
     @pl.when(run)
     def _body():
@@ -283,7 +293,7 @@ def _dkv_kernel(*refs, scale, causal, off, bq, bk, nq, g, has_mask,
         delta = delta_ref[0]
         p = _bwd_p(q, k, lse, None if mask_ref is None else mask_ref[0],
                    scale, causal, off, q_start, k_start, bq, bk,
-                   mask_rows, lk_real)
+                   mask_rows, lk_real, window)
         dv_s[...] += lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
         dp = lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
@@ -300,7 +310,7 @@ def _dkv_kernel(*refs, scale, causal, off, bq, bk, nq, g, has_mask,
 
 
 def _dq_kernel(*refs, scale, causal, off, bq, bk, nk, has_mask, mask_rows,
-               lk_real):
+               lk_real, window):
     if has_mask:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
          dq_ref, dq_s) = refs
@@ -319,6 +329,9 @@ def _dq_kernel(*refs, scale, causal, off, bq, bk, nk, has_mask, mask_rows,
     k_start = jk * bk
     run = (q_start + bq + off > k_start) if causal else (jk >= 0)
     run = jnp.logical_and(run, k_start < lk_real)
+    if window:
+        run = jnp.logical_and(run,
+                              k_start + bk - 1 > q_start + off - window)
 
     @pl.when(run)
     def _body():
@@ -329,7 +342,7 @@ def _dq_kernel(*refs, scale, causal, off, bq, bk, nk, has_mask, mask_rows,
         delta = delta_ref[0]
         p = _bwd_p(q, k, lse, None if mask_ref is None else mask_ref[0],
                    scale, causal, off, q_start, k_start, bq, bk,
-                   mask_rows, lk_real)
+                   mask_rows, lk_real, window)
         dp = lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
@@ -343,7 +356,7 @@ def _dq_kernel(*refs, scale, causal, off, bq, bk, nk, has_mask, mask_rows,
 
 
 def _bwd(q, k, v, o, lse, do, mask, causal, scale, bq, bk, interpret, H, Hkv,
-         mask_meta, lk_real):
+         mask_meta, lk_real, window=0):
     mask_meta = dict(mask_meta)
     BH, Lq, D = q.shape
     BHkv, Lk, _ = k.shape
@@ -385,7 +398,7 @@ def _bwd(q, k, v, o, lse, do, mask, causal, scale, bq, bk, interpret, H, Hkv,
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           off=off, bq=bq, bk=bk, nq=nq * g, g=g,
                           has_mask=has_mask, mask_rows=mask_rows,
-                          lk_real=lk_real),
+                          lk_real=lk_real, window=window),
         grid=(BHkv, nk, nq * g),
         in_specs=in_specs,
         out_specs=[
@@ -424,7 +437,7 @@ def _bwd(q, k, v, o, lse, do, mask, causal, scale, bq, bk, interpret, H, Hkv,
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           off=off, bq=bq, bk=bk, nk=nk,
                           has_mask=has_mask, mask_rows=mask_rows,
-                          lk_real=lk_real),
+                          lk_real=lk_real, window=window),
         grid=(BH, nq, nk),
         in_specs=in_specs2,
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
@@ -440,26 +453,26 @@ def _bwd(q, k, v, o, lse, do, mask, causal, scale, bq, bk, interpret, H, Hkv,
 
 # -------------------------------------------------------------- custom vjp
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10,
-                                                    11, 12))
+                                                    11, 12, 13))
 def _flash_core(q, k, v, mask, causal, scale, bq, bk, interpret, H, Hkv,
-                mask_meta, lk_real):
+                mask_meta, lk_real, window):
     o, _ = _fwd(q, k, v, mask, causal, scale, bq, bk, interpret, H, Hkv,
-                mask_meta, lk_real)
+                mask_meta, lk_real, window)
     return o
 
 
 def _flash_fwd_rule(q, k, v, mask, causal, scale, bq, bk, interpret, H, Hkv,
-                    mask_meta, lk_real):
+                    mask_meta, lk_real, window):
     o, lse = _fwd(q, k, v, mask, causal, scale, bq, bk, interpret, H, Hkv,
-                  mask_meta, lk_real)
+                  mask_meta, lk_real, window)
     return o, (q, k, v, mask, o, lse)
 
 
 def _flash_bwd_rule(causal, scale, bq, bk, interpret, H, Hkv, mask_meta,
-                    lk_real, res, do):
+                    lk_real, window, res, do):
     q, k, v, mask, o, lse = res
     dq, dk, dv = _bwd(q, k, v, o, lse, do, mask, causal, scale, bq, bk,
-                      interpret, H, Hkv, mask_meta, lk_real)
+                      interpret, H, Hkv, mask_meta, lk_real, window)
     # masks are inputs, not trained parameters: zero cotangent
     dmask = None if mask is None else jnp.zeros_like(mask)
     return dq, dk, dv, dmask
@@ -492,10 +505,19 @@ def _normalize_mask(mask, B, H, Lq, Lk):
 
 
 def flash_attention(q, k, v, mask=None, is_causal=False, scale=None,
-                    block_q=None, block_k=None, interpret=False):
+                    block_q=None, block_k=None, interpret=False,
+                    window=None):
     """Flash attention on (B, L, H, D) arrays; D padded to the lane width,
     seq lens padded to the block grid, GQA via kv-head grouping.
-    Returns (B, Lq, H, D) in the input dtype."""
+    Returns (B, Lq, H, D) in the input dtype.
+
+    ``window`` (sliding-window attention, Mistral-style): row r attends
+    only cols in (r+off-window, r+off].  Requires is_causal; KV blocks
+    entirely left of the band are SKIPPED, so compute scales with
+    window*Lq instead of Lq*Lk at long context."""
+    window = int(window or 0)
+    if window and not is_causal:
+        raise ValueError("window requires is_causal=True")
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
     Hkv = k.shape[2]
@@ -530,7 +552,8 @@ def flash_attention(q, k, v, mask=None, is_causal=False, scale=None,
     if m3 is not None and mask_meta["rows"] != 1:
         mask_meta["rows"] = Lqp
     o = _flash_core(qb, kb, vb, m3, bool(is_causal), scale, bq, bk,
-                    bool(interpret), H, Hkv, _hashable(mask_meta), Lk)
+                    bool(interpret), H, Hkv, _hashable(mask_meta), Lk,
+                    window)
     if Lqp != Lq or Dp != D:
         o = o[:, :Lq, :D]
     return o.reshape(B, H, Lq, D).transpose(0, 2, 1, 3)

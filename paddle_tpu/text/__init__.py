@@ -28,3 +28,5 @@ from .transformer_mt import (  # noqa: F401
 from .peft import (  # noqa: F401
     LoRAConfig, LoRAModel, LoRALinear, get_peft_model,
 )
+from .qwen import Qwen2Config, Qwen2Model, Qwen2ForCausalLM  # noqa: F401
+from .convert import convert_hf_qwen2  # noqa: F401

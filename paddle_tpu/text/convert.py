@@ -27,7 +27,7 @@ import numpy as np
 import jax.numpy as jnp
 
 __all__ = ["convert_hf_llama", "convert_hf_bert", "convert_hf_gpt2",
-           "convert_hf_ernie"]
+           "convert_hf_ernie", "convert_hf_qwen2"]
 
 
 def _np(t):
@@ -83,14 +83,16 @@ def _rope_perm(w_out_in, n_heads, head_dim):
     return w.reshape(n_heads * head_dim, -1)
 
 
-def convert_hf_llama(model, hf):
-    """transformers Llama{Model,ForCausalLM} (or its state_dict) -> our
-    LlamaForCausalLM."""
+def _convert_llama_family(model, hf, label, attention_bias):
+    """Shared HF -> ours mapping for the llama-architecture family
+    (llama: no attention bias; qwen2: biased q/k/v, with the SAME
+    half-split -> interleaved rope row permutation applied to the q/k
+    biases — a bias is one more rope-rotated row)."""
     sd = _state(hf)
     pre = "model." if any(k.startswith("model.") for k in sd) else ""
     cfg = model.cfg
     _check_layer_count(sd, rf"{re.escape(pre)}layers\.(\d+)\.",
-                       cfg.num_layers, "hf_llama")
+                       cfg.num_layers, label)
     dh = cfg.hidden_size // cfg.num_heads
     out = {"llama.embed_tokens.weight": sd[pre + "embed_tokens.weight"],
            "llama.norm.weight": sd[pre + "norm.weight"]}
@@ -113,9 +115,32 @@ def convert_hf_llama(model, hf):
             sd[h + "self_attn.v_proj.weight"].T
         out[o + "self_attn.o_proj.weight"] = \
             sd[h + "self_attn.o_proj.weight"].T
+        if attention_bias:
+            out[o + "self_attn.q_proj.bias"] = _rope_perm(
+                sd[h + "self_attn.q_proj.bias"][:, None], cfg.num_heads,
+                dh).reshape(-1)
+            out[o + "self_attn.k_proj.bias"] = _rope_perm(
+                sd[h + "self_attn.k_proj.bias"][:, None],
+                cfg.num_kv_heads, dh).reshape(-1)
+            out[o + "self_attn.v_proj.bias"] = \
+                sd[h + "self_attn.v_proj.bias"]
         for w in ("gate_proj", "up_proj", "down_proj"):
             out[o + f"mlp.{w}.weight"] = sd[h + f"mlp.{w}.weight"].T
     return _assign(model, out)
+
+
+def convert_hf_llama(model, hf):
+    """transformers Llama{Model,ForCausalLM} (or its state_dict) -> our
+    LlamaForCausalLM."""
+    return _convert_llama_family(model, hf, "hf_llama",
+                                 attention_bias=False)
+
+
+def convert_hf_qwen2(model, hf):
+    """transformers Qwen2{Model,ForCausalLM} (or state_dict) -> our
+    Qwen2ForCausalLM (llama mapping + rope-permuted q/k/v biases)."""
+    return _convert_llama_family(model, hf, "hf_qwen2",
+                                 attention_bias=True)
 
 
 def convert_hf_bert(model, hf):

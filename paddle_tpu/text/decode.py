@@ -32,9 +32,11 @@ def _lru_compiled(store, key, build, cap=8):
     return fn
 
 
-def _update_prealloc_cache(cache, k, v, s):
+def _update_prealloc_cache(cache, k, v, s, window=None):
     """Write k/v at cache['pos'] and return full buffers + bool attn mask.
-    pos may be scalar (shared offset) or [b] (per-row offsets)."""
+    pos may be scalar (shared offset) or [b] (per-row offsets).  With
+    ``window`` (sliding-window attention) a row at absolute position r
+    attends cache slots in (r-window, r] instead of [0, r]."""
     from .. import tensor_api as T
     from ..ops import call as ops_call
     pos = cache["pos"]
@@ -46,12 +48,18 @@ def _update_prealloc_cache(cache, k, v, s):
     if pos.ndim == 0:
         rows = (pos.astype("int32")
                 + T.arange(s, dtype="int32")).unsqueeze(1)   # [s, 1]
-        mask = (cols <= rows).reshape([1, 1, s, L])
+        mask = cols <= rows
+        if window:
+            mask = mask & (cols > rows - window)
+        mask = mask.reshape([1, 1, s, L])
     else:
         rows = (pos.astype("int32").unsqueeze(1)
                 + T.arange(s, dtype="int32").unsqueeze(0))   # [b, s]
-        mask = (rows.unsqueeze(2) >= cols.unsqueeze(0)       # [b, s, L]
-                ).unsqueeze(1)                               # [b, 1, s, L]
+        mask = rows.unsqueeze(2) >= cols.unsqueeze(0)        # [b, s, L]
+        if window:
+            mask = mask & (rows.unsqueeze(2) - window
+                           < cols.unsqueeze(0))
+        mask = mask.unsqueeze(1)                             # [b, 1, s, L]
     return K, V, mask
 
 

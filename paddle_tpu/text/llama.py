@@ -33,7 +33,8 @@ class LlamaConfig:
                  max_position_embeddings=4096, rms_norm_eps=1e-6,
                  rope_theta=10000.0, initializer_range=0.02,
                  use_recompute=False, sequence_parallel=False,
-                 context_parallel=False, tensor_parallel=None):
+                 context_parallel=False, tensor_parallel=None,
+                 attention_bias=False, sliding_window=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -53,6 +54,16 @@ class LlamaConfig:
         self.context_parallel = context_parallel
         self.tensor_parallel = tensor_parallel if tensor_parallel is not None \
             else mesh_mod.degree("mp") > 1
+        # attention_bias: q/k/v projections carry bias (Qwen2-style).
+        # sliding_window: Mistral-style banded causal attention — the
+        # pallas kernel skips KV blocks left of the band, so long-context
+        # compute scales with window*L, not L^2.
+        self.attention_bias = attention_bias
+        self.sliding_window = sliding_window
+        if sliding_window and context_parallel:
+            raise ValueError(
+                "sliding_window does not compose with context_parallel "
+                "(the ring rotates full KV shards); pick one")
 
     @classmethod
     def from_preset(cls, name, **kw):
@@ -76,14 +87,15 @@ def _rope(q, k, positions, theta):
     return rot(q), rot(k)
 
 
-def _tp_linear(cfg, in_f, out_f, column=True):
+def _tp_linear(cfg, in_f, out_f, column=True, bias=False):
     init = nn.initializer.Normal(0.0, cfg.initializer_range)
     if cfg.tensor_parallel:
         l = (ColumnParallelLinear if column else RowParallelLinear)(
-            in_f, out_f, has_bias=False)
+            in_f, out_f, has_bias=bias)
         init(l.weight)
         return l
-    return nn.Linear(in_f, out_f, weight_attr=init, bias_attr=False)
+    return nn.Linear(in_f, out_f, weight_attr=init,
+                     bias_attr=None if bias else False)
 
 
 class LlamaAttention(nn.Layer):
@@ -92,11 +104,14 @@ class LlamaAttention(nn.Layer):
         self.cfg = cfg
         self.head_dim = cfg.hidden_size // cfg.num_heads
         self.q_proj = _tp_linear(cfg, cfg.hidden_size,
-                                 cfg.num_heads * self.head_dim)
+                                 cfg.num_heads * self.head_dim,
+                                 bias=cfg.attention_bias)
         self.k_proj = _tp_linear(cfg, cfg.hidden_size,
-                                 cfg.num_kv_heads * self.head_dim)
+                                 cfg.num_kv_heads * self.head_dim,
+                                 bias=cfg.attention_bias)
         self.v_proj = _tp_linear(cfg, cfg.hidden_size,
-                                 cfg.num_kv_heads * self.head_dim)
+                                 cfg.num_kv_heads * self.head_dim,
+                                 bias=cfg.attention_bias)
         self.o_proj = _tp_linear(cfg, cfg.num_heads * self.head_dim,
                                  cfg.hidden_size, column=False)
 
@@ -133,17 +148,28 @@ class LlamaAttention(nn.Layer):
                                  "theta": cfg.rope_theta})
 
         mask = None
+        W = cfg.sliding_window
         if prealloc:
             from .decode import _update_prealloc_cache
-            k, v, mask = _update_prealloc_cache(cache, k, v, s)
+            k, v, mask = _update_prealloc_cache(cache, k, v, s, window=W)
         elif cache is not None:
             k = T.concat([cache["k"], k], axis=1)
             v = T.concat([cache["v"], v], axis=1)
             cache["k"], cache["v"] = k, v
+            if W:
+                # banded mask over the concatenated window (row r sits at
+                # absolute position Lk - s + r; attends cols in
+                # (abs_r - W, abs_r])
+                Lk = k.shape[1]
+                cols = T.arange(Lk, dtype="int32").unsqueeze(0)
+                rows = (Lk - s
+                        + T.arange(s, dtype="int32")).unsqueeze(1)
+                mask = ((cols <= rows)
+                        & (cols > rows - W)).reshape([1, 1, s, Lk])
         # GQA heads stay UNREPEATED: the sdpa dispatch handles grouping —
         # natively inside the pallas flash kernel (kv-head index map), or
         # via repeat_interleave in the XLA fallback (sdpa_k)
-        if prealloc:
+        if prealloc or mask is not None:
             out = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=mask, dropout_p=0.0,
                 training=self.training)
@@ -157,7 +183,8 @@ class LlamaAttention(nn.Layer):
         else:
             out = F.scaled_dot_product_attention(
                 q, k, v, is_causal=(cache is None or s > 1), dropout_p=0.0,
-                training=self.training)
+                training=self.training,
+                sliding_window=W if cache is None else None)
         return self.o_proj(out.reshape([b, s, -1]))
 
 

@@ -159,6 +159,16 @@ class LoRAModel(nn.Layer):
             p.stop_gradient = not trainable
 
     def forward(self, *args, **kwargs):
+        if self.training and any(
+                s.merged for s in self.model.sublayers()
+                if isinstance(s, LoRALinear)):
+            # merged adapters short-circuit to the base layer, so a
+            # training forward would produce exactly-zero adapter grads
+            # — a silent no-op fine-tune.  Fail loudly instead.
+            raise RuntimeError(
+                "training forward with MERGED adapters: gradients to "
+                "lora_A/lora_B would be zero. unmerge() first (and "
+                "rebuild any compiled train step).")
         return self.model(*args, **kwargs)
 
     def __getattr__(self, name):

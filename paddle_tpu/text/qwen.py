@@ -1,0 +1,52 @@
+"""Qwen2 family (reference analog: PaddleNLP transformers/qwen2).
+
+Architecturally Qwen2 is the LLaMA block with BIASED q/k/v projections
+(and much larger vocab / higher rope theta); PaddleNLP's qwen2 modeling
+mirrors its llama modeling the same way, so here the model classes ARE
+the Llama classes specialized through the config — one attention/MLP
+implementation serves both families (GQA, RMSNorm, SwiGLU, rope,
+preallocated-cache decode, tensor parallel, LoRA targeting,
+sliding_window all come along for free).
+"""
+from __future__ import annotations
+
+from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel
+
+
+class Qwen2Config(LlamaConfig):
+    PRESETS = {
+        "qwen2-0.5b": dict(hidden_size=896, num_layers=24, num_heads=14,
+                           num_kv_heads=2, intermediate_size=4864,
+                           vocab_size=151936, rope_theta=1000000.0,
+                           max_position_embeddings=32768),
+        "qwen2-1.5b": dict(hidden_size=1536, num_layers=28, num_heads=12,
+                           num_kv_heads=2, intermediate_size=8960,
+                           vocab_size=151936, rope_theta=1000000.0,
+                           max_position_embeddings=32768),
+        "qwen2-7b": dict(hidden_size=3584, num_layers=28, num_heads=28,
+                         num_kv_heads=4, intermediate_size=18944,
+                         vocab_size=152064, rope_theta=1000000.0,
+                         max_position_embeddings=32768),
+        "qwen2-tiny": dict(hidden_size=128, num_layers=2, num_heads=4,
+                           num_kv_heads=2, intermediate_size=256,
+                           vocab_size=256, max_position_embeddings=128),
+    }
+
+    def __init__(self, **kw):
+        kw.setdefault("attention_bias", True)   # the Qwen2 signature
+        super().__init__(**kw)
+
+
+class Qwen2Model(LlamaModel):
+    pass
+
+
+class Qwen2ForCausalLM(LlamaForCausalLM):
+    """Same graph as LlamaForCausalLM; the inner module keeps the
+    ``llama`` attribute name (state dicts interop with the fleet pp
+    decomposition and LoRA target patterns unchanged)."""
+
+    def __init__(self, cfg):
+        if not isinstance(cfg, Qwen2Config):
+            raise TypeError("Qwen2ForCausalLM expects a Qwen2Config")
+        super().__init__(cfg)
