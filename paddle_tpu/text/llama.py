@@ -26,6 +26,13 @@ class LlamaConfig:
                           intermediate_size=13824),
         "llama-tiny": dict(hidden_size=256, num_layers=2, num_heads=4,
                            intermediate_size=688),
+        # Mistral = the llama block + GQA(8 kv) + sliding-window 4096
+        # (identical weight layout, so convert_hf_llama loads it)
+        "mistral-7b": dict(hidden_size=4096, num_layers=32, num_heads=32,
+                           num_kv_heads=8, intermediate_size=14336,
+                           vocab_size=32000, rope_theta=10000.0,
+                           max_position_embeddings=32768,
+                           sliding_window=4096),
     }
 
     def __init__(self, vocab_size=32000, hidden_size=4096, num_layers=32,

@@ -225,3 +225,35 @@ def test_sliding_window_without_causal_raises():
     q = pt.randn([1, 8, 2, 16])
     with pytest.raises(ValueError, match="is_causal"):
         F.scaled_dot_product_attention(q, q, q, sliding_window=4)
+
+
+def test_mistral_matches_transformers():
+    """Mistral = llama weights + GQA + sliding window: loads through
+    convert_hf_llama, and OUR banded attention must reproduce the HF
+    Mistral forward when seq > window."""
+    import torch
+    from paddle_tpu.text.convert import convert_hf_llama
+    from transformers import MistralConfig as HFC, \
+        MistralForCausalLM as HFM
+
+    torch.manual_seed(0)
+    W = 8
+    hf = HFM(HFC(vocab_size=128, hidden_size=64, intermediate_size=128,
+                 num_hidden_layers=2, num_attention_heads=4,
+                 num_key_value_heads=2, max_position_embeddings=64,
+                 rope_theta=10000.0, rms_norm_eps=1e-6,
+                 sliding_window=W, attention_dropout=0.0,
+                 attn_implementation="eager")).eval()
+    pt.seed(0)
+    ours = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=128,
+        max_position_embeddings=64, rms_norm_eps=1e-6,
+        rope_theta=10000.0, tensor_parallel=False, sliding_window=W))
+    ours.eval()
+    convert_hf_llama(ours, hf)
+    ids = np.random.RandomState(0).randint(0, 128, (2, 24))  # seq 3x W
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(pt.to_tensor(ids))._array)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

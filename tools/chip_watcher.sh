@@ -55,6 +55,10 @@ while true; do
                 >> /tmp/watch_ring.out 2>&1
             ring_rc=$?
             echo "== ring_bench rc=$ring_rc"
+            echo "== draining on-chip queue: swa_bench --chip"
+            timeout 1200 python tools/swa_bench.py --chip \
+                >> /tmp/watch_swa.out 2>&1
+            echo "== swa_bench rc=$?"
             # only mark drained when both succeeded — a claim drop
             # mid-drain must retry on the next measured window
             if [ "$tune_rc" -eq 0 ] && [ "$ring_rc" -eq 0 ]; then
