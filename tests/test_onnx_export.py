@@ -209,3 +209,20 @@ class TestOnnxExport:
         # evaluator executes at any batch
         out = ponnx.run(model, [np.random.randn(7, 4).astype(np.float32)])
         assert out[0].shape == (7, 2)
+
+
+def test_qwen2_roundtrip(tmp_path):
+    """Qwen2 (biased q/k/v llama block) exports and re-evaluates."""
+    from paddle_tpu.text import Qwen2Config, Qwen2ForCausalLM
+    pt.seed(0)
+    m = Qwen2ForCausalLM(Qwen2Config.from_preset(
+        "qwen2-tiny", tensor_parallel=False))
+    m.eval()
+    ids = pt.randint(0, 256, [2, 12])
+    want = np.asarray(m(ids)._array)
+    from paddle_tpu.static import InputSpec
+    path = ponnx.export(m, str(tmp_path / "qwen2"),
+                        input_spec=[InputSpec([2, 12], "int64",
+                                              "input_ids")])
+    got = ponnx.run(path, {"input_ids": np.asarray(ids._array)})[0]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
