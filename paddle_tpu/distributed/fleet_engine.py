@@ -303,12 +303,19 @@ class DistributedTrainStep:
                 gmap.get(id(p), (1.0, None))[0] for p in flat_ps]
             self._fleet_wd_overrides = [
                 gmap.get(id(p), (1.0, None))[1] for p in flat_ps]
+            self._fleet_init_frozen = [p.stop_gradient for p in flat_ps]
         if not self.use_pp:
             self._fleet_param_names = [
                 n for n, _ in self.model.named_parameters()]
+            self._fleet_init_frozen = [
+                p.stop_gradient for _, p in self.model.named_parameters()]
         arrays, flat_specs = self._flat_param_arrays()
         if self._opt_state is None:
-            self._opt_state = self.optimizer.init_state(arrays)
+            # frozen params (stop_gradient — e.g. a LoRA fine-tune's base
+            # under the hybrid engine) get NO optimizer slots; the step's
+            # None-grad masking passes their empty slots through untouched
+            self._opt_state = self.optimizer.init_state(
+                arrays, frozen=getattr(self, "_fleet_init_frozen", None))
         self._merge_pending_sd()
         placed_state = []
         for slots, spec in zip(self._opt_state, flat_specs):

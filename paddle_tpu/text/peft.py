@@ -14,6 +14,13 @@ checkpoints interop with the plain model classes).  Adapter creation
 goes through ``create_parameter`` (LazyGuard-deferrable) and
 merge/unmerge batch every layer's delta into ONE jitted program — no
 per-layer round-trips on a tunneled TPU.
+
+Composes with the fleet hybrid engine (dp/ZeRO shard the adapter
+gradients; the engine's init_state also skips frozen slots).  Known
+limit: target_modules match plain ``nn.Linear`` only — tensor-parallel
+Column/RowParallelLinear projections are not wrapped yet (build the
+base with ``tensor_parallel=False`` to fine-tune, or target the
+unsharded projections).
 """
 from __future__ import annotations
 

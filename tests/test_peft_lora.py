@@ -144,3 +144,28 @@ def test_frozen_params_get_no_optimizer_state():
             assert slots, n                    # adapters: real moments
         else:
             assert slots == {}, n              # frozen: zero HBM
+
+
+def test_lora_under_fleet_dp_zero2():
+    """LoRA composes with the hybrid engine: dp8 + ZeRO-2 on the virtual
+    mesh, base weights bit-frozen, optimizer slots EMPTY for the frozen
+    base (fleet init_state takes the frozen mask too)."""
+    from paddle_tpu.distributed import fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 8,
+                               "sharding_stage": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    lora = LoRAModel(_gpt(31), LoRAConfig(r=4))
+    opt = pt.optimizer.AdamW(learning_rate=1e-2,
+                             parameters=lora.trainable_parameters())
+    step = fleet.build_train_step(lora, gpt_loss_fn, opt)
+    ids = pt.randint(0, 64, [8, 16])
+    before = _snapshot(lora.model, lambda n: "lora_" not in n)
+    losses = [float(step(ids, ids)) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+    after = _snapshot(lora.model, lambda n: "lora_" not in n)
+    for n in before:
+        np.testing.assert_array_equal(before[n], after[n], err_msg=n)
+    empt = sum(1 for s in step._opt_state if s == {})
+    assert 0 < empt < len(step._opt_state)
