@@ -16,11 +16,11 @@ merge/unmerge batch every layer's delta into ONE jitted program — no
 per-layer round-trips on a tunneled TPU.
 
 Composes with the fleet hybrid engine (dp/ZeRO shard the adapter
-gradients; the engine's init_state also skips frozen slots).  Known
-limit: target_modules match plain ``nn.Linear`` only — tensor-parallel
-Column/RowParallelLinear projections are not wrapped yet (build the
-base with ``tensor_parallel=False`` to fine-tune, or target the
-unsharded projections).
+gradients; the engine's init_state also skips frozen slots) and with
+tensor parallelism: Column/RowParallelLinear projections wrap too, the
+adapters carrying the matching Megatron shardings (B column-sharded
+for column-parallel bases, A row-sharded for row-parallel ones) so
+GSPMD keeps the adapter math local to each mp shard.
 """
 from __future__ import annotations
 
@@ -67,22 +67,31 @@ class LoRALinear(nn.Layer):
 
     def __init__(self, base, r, alpha, dropout=0.0):
         super().__init__()
-        if not isinstance(base, nn.Linear):
+        from ..distributed.parallel_layers import (ColumnParallelLinear,
+                                                   RowParallelLinear)
+        if not isinstance(base, (nn.Linear, ColumnParallelLinear,
+                                 RowParallelLinear)):
             raise TypeError(
-                f"LoRALinear wraps nn.Linear, got {type(base).__name__}")
+                "LoRALinear wraps nn.Linear / Column-/RowParallelLinear, "
+                f"got {type(base).__name__}")
         from ..nn import initializer as I
+        from jax.sharding import PartitionSpec as PS
         self.base = base
         self.r = r
         self.scaling = alpha / r
         self._dropout_p = dropout
-        fan_in = base.in_features
+        fan_in, fan_out = base.weight.shape  # reference layout [in, out]
         # create_parameter: LazyGuard-deferrable, so wrapping a large
         # model under a guard materializes ALL adapters in one jit
         self.lora_A = self.create_parameter(
             [fan_in, r],
             default_initializer=I.Normal(std=1.0 / np.sqrt(fan_in)))
         self.lora_B = self.create_parameter(
-            [r, base.out_features], default_initializer=I.Constant(0.0))
+            [r, fan_out], default_initializer=I.Constant(0.0))
+        if isinstance(base, ColumnParallelLinear):
+            self.lora_B.pspec = PS(None, "mp")   # match W's out split
+        elif isinstance(base, RowParallelLinear):
+            self.lora_A.pspec = PS("mp", None)   # match W's in split
         self.merged = False
 
     def forward(self, x):
@@ -114,8 +123,8 @@ class LoRALinear(nn.Layer):
         self.merged = False
 
     def extra_repr(self):
-        return (f"in={self.base.in_features}, "
-                f"out={self.base.out_features}, r={self.r}, "
+        fi, fo = self.base.weight.shape
+        return (f"in={fi}, out={fo}, r={self.r}, "
                 f"scale={self.scaling}, merged={self.merged}")
 
 
@@ -131,8 +140,11 @@ class LoRAModel(nn.Layer):
         self.lora_config = lora_config
         pats = [re.compile(p + "$") for p in lora_config.target_modules]
         replaced = []
+        from ..distributed.parallel_layers import (ColumnParallelLinear,
+                                                   RowParallelLinear)
+        wrappable = (nn.Linear, ColumnParallelLinear, RowParallelLinear)
         for path, sub in list(model.named_sublayers()):
-            if not isinstance(sub, nn.Linear):
+            if not isinstance(sub, wrappable):
                 continue
             if not any(p.match(path) for p in pats):
                 continue

@@ -169,3 +169,39 @@ def test_lora_under_fleet_dp_zero2():
         np.testing.assert_array_equal(before[n], after[n], err_msg=n)
     empt = sum(1 for s in step._opt_state if s == {})
     assert 0 < empt < len(step._opt_state)
+
+
+def test_lora_wraps_tensor_parallel_linears():
+    """Column/RowParallelLinear projections wrap too: the adapters carry
+    Megatron-matching shardings (B col-sharded / A row-sharded) and
+    train under dp x mp with the base bit-frozen."""
+    from paddle_tpu.distributed import fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2,
+                               "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, max_position_embeddings=32,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    tensor_parallel=True)
+    lora = LoRAModel(GPTForCausalLM(cfg), LoRAConfig(
+        r=4, target_modules=[".*qkv_proj", ".*out_proj"]))
+    assert len(lora.replaced) == 4
+    # adapter shardings follow the base split
+    subs = {p: s for p, s in lora.model.named_sublayers()
+            if isinstance(s, LoRALinear)}
+    qkv = subs["gpt.h.0.attn.qkv_proj"]
+    out = subs["gpt.h.0.attn.out_proj"]
+    assert tuple(qkv.lora_B.pspec) == (None, "mp")
+    assert tuple(out.lora_A.pspec) == ("mp", None)
+    opt = pt.optimizer.AdamW(learning_rate=1e-2,
+                             parameters=lora.trainable_parameters())
+    step = fleet.build_train_step(lora, gpt_loss_fn, opt)
+    ids = pt.randint(0, 64, [8, 16])
+    before = _snapshot(lora.model, lambda n: "lora_" not in n)
+    losses = [float(step(ids, ids)) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+    after = _snapshot(lora.model, lambda n: "lora_" not in n)
+    for n in before:
+        np.testing.assert_array_equal(before[n], after[n], err_msg=n)
