@@ -173,6 +173,127 @@ def jit_generate(model, input_ids, max_new_tokens=20, do_sample=False,
             model.train()
 
 
+def jit_beam_search(model, input_ids, beam_size=4, max_new_tokens=20,
+                    length_penalty=1.0, eos_token_id=None):
+    """Beam-search decode as ONE jitted XLA program (prefill + while_loop
+    over tokens), token-compatible with the eager
+    ``generation.beam_search``: beams ride the batch axis ([b*beam]),
+    every step is one batched forward over the preallocated KV caches,
+    and each beam reorder gathers the cache rows in-program (a device
+    gather XLA keeps inside the loop — no host round-trips).
+
+    Returns [b, prompt + max_new_tokens]; with ``eos_token_id`` the
+    positions after a winning beam finishes hold eos (the frozen-beam
+    continuation), where the eager loop would have stopped early.
+    """
+    from ..framework import random as _random  # noqa: F401 (parity import)
+    beam = int(beam_size)
+    b, prompt_len = input_ids.shape
+    bb = b * beam
+    total = prompt_len + max_new_tokens
+    was_training = model.training
+    model.eval()
+    try:
+        pn, p_arrays, bn, b_arrays = FB.split_state(model)
+        proto = model.new_caches(bb, dtype=p_arrays[0].dtype,
+                                 max_length=total)
+        cache_arrays = [(c["k"]._array, c["v"]._array) for c in proto]
+
+        ckey = ("beam", prompt_len, max_new_tokens, beam,
+                float(length_penalty), eos_token_id, b)
+        jcache = model.__dict__.setdefault("_jit_decode_cache", {})
+
+        def _build():
+            def gather_caches(caches, g):
+                return [(ck[g], cv[g]) for ck, cv in caches]
+
+            def pure(p_arrays, b_arrays, ids, caches):
+                ids = jnp.repeat(ids.astype(jnp.int32), beam, axis=0)
+                logits, caches = _model_step(
+                    model, pn, bn, p_arrays, b_arrays, ids, caches,
+                    jnp.asarray(0, jnp.int32))
+                logp = jax.nn.log_softmax(
+                    logits[:, -1, :].astype(jnp.float32), axis=-1)
+                V = logp.shape[-1]
+                # step 0: all beams identical — only beam 0 competes
+                init = jnp.tile(jnp.asarray([0.0] + [-1e9] * (beam - 1)),
+                                b)[:, None]
+                scores = (logp + init).reshape(b, beam * V)
+                beam_scores, top = jax.lax.top_k(scores, beam)
+                src_beam = top // V
+                tok = (top % V).astype(jnp.int32)
+                g = (jnp.arange(b)[:, None] * beam + src_beam).reshape(-1)
+                fill = eos_token_id if eos_token_id is not None else 0
+                buf = jnp.full((bb, total), fill, jnp.int32)
+                buf = lax.dynamic_update_slice(buf, ids, (0, 0))
+                buf = buf[g].at[:, prompt_len].set(tok.reshape(-1))
+                caches = gather_caches(caches, g)
+                beam_scores = beam_scores.reshape(-1)
+                finished = jnp.zeros((bb,), bool)
+                if eos_token_id is not None:
+                    finished = buf[:, prompt_len] == eos_token_id
+                gen_lens = jnp.ones((bb,), jnp.float32)
+
+                def cond(state):
+                    i, _, _, fin, _, _ = state
+                    alive = jnp.asarray(True) if eos_token_id is None \
+                        else ~fin.all()
+                    return (i < total) & alive
+
+                def body(state):
+                    i, buf, beam_scores, finished, gen_lens, caches = state
+                    cur = lax.dynamic_slice(buf, (0, i - 1), (bb, 1))
+                    logits, caches = _model_step(
+                        model, pn, bn, p_arrays, b_arrays, cur, caches,
+                        i - 1)
+                    logp = jax.nn.log_softmax(
+                        logits[:, -1, :].astype(jnp.float32), axis=-1)
+                    if eos_token_id is not None:
+                        # finished beams only extend with eos, score kept
+                        frozen = jnp.full((V,), -jnp.inf).at[
+                            eos_token_id].set(0.0)
+                        logp = jnp.where(finished[:, None],
+                                         frozen[None, :], logp)
+                    scores = (beam_scores[:, None] + logp).reshape(
+                        b, beam * V)
+                    bs, top = jax.lax.top_k(scores, beam)
+                    src_beam = top // V
+                    tok = (top % V).astype(jnp.int32)
+                    g = (jnp.arange(b)[:, None] * beam
+                         + src_beam).reshape(-1)
+                    buf = lax.dynamic_update_slice(
+                        buf[g], tok.reshape(-1, 1), (0, i))
+                    caches = gather_caches(caches, g)
+                    gen_lens = gen_lens[g] + (~finished[g]).astype(
+                        jnp.float32)
+                    if eos_token_id is not None:
+                        finished = finished[g] | (tok.reshape(-1)
+                                                  == eos_token_id)
+                    else:
+                        finished = finished[g]
+                    return (i + 1, buf, bs.reshape(-1), finished,
+                            gen_lens, caches)
+
+                state = (jnp.asarray(prompt_len + 1, jnp.int32), buf,
+                         beam_scores, finished, gen_lens, caches)
+                _, buf, beam_scores, finished, gen_lens, caches = \
+                    lax.while_loop(cond, body, state)
+                pen = ((5.0 + gen_lens) / 6.0) ** length_penalty
+                final = beam_scores / pen
+                best = jnp.argmax(final.reshape(b, beam), axis=1)
+                pick = jnp.arange(b) * beam + best
+                return buf[pick]
+
+            return jax.jit(pure)
+
+        fn = _lru_compiled(jcache, ckey, _build)
+        out = fn(p_arrays, b_arrays, input_ids._array, cache_arrays)
+        return Tensor._from_array(out)
+    finally:
+        if was_training:
+            model.train()
+
+
 def speculative_generate(model, draft_model, input_ids, max_new_tokens=20,
                          num_speculative_tokens=4, do_sample=False,
                          temperature=1.0, top_k=None, top_p=None,
