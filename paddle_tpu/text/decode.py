@@ -186,7 +186,6 @@ def jit_beam_search(model, input_ids, beam_size=4, max_new_tokens=20,
     positions after a winning beam finishes hold eos (the frozen-beam
     continuation), where the eager loop would have stopped early.
     """
-    from ..framework import random as _random  # noqa: F401 (parity import)
     beam = int(beam_size)
     b, prompt_len = input_ids.shape
     bb = b * beam

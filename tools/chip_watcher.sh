@@ -58,10 +58,12 @@ while true; do
             echo "== draining on-chip queue: swa_bench --chip"
             timeout 1200 python tools/swa_bench.py --chip \
                 >> /tmp/watch_swa.out 2>&1
-            echo "== swa_bench rc=$?"
-            # only mark drained when both succeeded — a claim drop
-            # mid-drain must retry on the next measured window
-            if [ "$tune_rc" -eq 0 ] && [ "$ring_rc" -eq 0 ]; then
+            swa_rc=$?
+            echo "== swa_bench rc=$swa_rc"
+            # only mark drained when ALL queue items succeeded — a claim
+            # drop mid-drain must retry on the next measured window
+            if [ "$tune_rc" -eq 0 ] && [ "$ring_rc" -eq 0 ] \
+                    && [ "$swa_rc" -eq 0 ]; then
                 DRAINED=1
             fi
         fi

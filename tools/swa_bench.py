@@ -31,6 +31,11 @@ def main():
     ap.add_argument("--rounds", type=int, default=5)
     args = ap.parse_args()
 
+    if not args.chip and not args.cpu:
+        # default SAFE: a bare run must not initialize the TPU backend
+        # (the axon claim can hang unkillably when down) — require an
+        # explicit --chip opt-in, else run the CPU wiring smoke
+        args.cpu = True
     import jax
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
