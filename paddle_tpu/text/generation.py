@@ -37,13 +37,27 @@ def _sample_next(logits, temperature, top_k, top_p, greedy):
 
 def generate(model, input_ids, max_new_tokens=20, do_sample=False,
              temperature=1.0, top_k=None, top_p=None, eos_token_id=None,
-             draft_model=None, num_speculative_tokens=4):
+             draft_model=None, num_speculative_tokens=4, num_beams=1,
+             length_penalty=1.0):
     """Returns Tensor [b, prompt + new] of token ids.  Passing
     ``draft_model`` routes through speculative decoding
     (decode.speculative_generate): greedy output is token-identical to
     the plain path; sampled output is distributionally equivalent (the
     stochastic acceptance rule preserves the target's sampling law but
-    consumes a different RNG stream, so individual tokens differ)."""
+    consumes a different RNG stream, so individual tokens differ).
+    ``num_beams > 1`` routes through the jitted beam search
+    (decode.jit_beam_search — the whole beam loop is one compiled
+    program)."""
+    if num_beams > 1:
+        if do_sample or draft_model is not None:
+            raise NotImplementedError(
+                "beam search does not compose with do_sample or "
+                "draft_model")
+        from .decode import jit_beam_search
+        return jit_beam_search(model, input_ids, beam_size=num_beams,
+                               max_new_tokens=max_new_tokens,
+                               length_penalty=length_penalty,
+                               eos_token_id=eos_token_id)
     if draft_model is not None:
         from .decode import speculative_generate
         # both paths yield int32 ids (Tensor wrapping canonicalizes 64-bit)

@@ -123,3 +123,21 @@ class TestJitBeamSearch:
         # exit is eos padding (frozen-beam continuations)
         if got.shape[1] > L:
             assert (got[:, L:] == eos).all()
+
+
+def test_generate_routes_num_beams():
+    from paddle_tpu.text.generation import generate, beam_search
+    pt.seed(11)
+    cfg = GPTConfig(vocab_size=96, hidden_size=48, num_layers=2,
+                    num_heads=4, max_position_embeddings=64,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    tensor_parallel=False)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = pt.to_tensor(np.array([[5, 17, 40, 3]], np.int64))
+    want = beam_search(m, ids, beam_size=3, max_new_tokens=6).numpy()
+    got = generate(m, ids, max_new_tokens=6, num_beams=3).numpy()
+    np.testing.assert_array_equal(got, want)
+    import pytest as _pt
+    with _pt.raises(NotImplementedError, match="compose"):
+        generate(m, ids, num_beams=2, do_sample=True)
