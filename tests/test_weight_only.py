@@ -145,3 +145,24 @@ class TestWeightQuantize:
         with pytest.raises(NotImplementedError, match="group"):
             weight_only_linear(pt.rand([2, 8]), q, weight_scale=s,
                                group_size=64)
+
+
+def test_weight_only_composes_with_jit_beam_search():
+    """Serving composition: an int8 weight-only-converted GPT decodes
+    through the jitted beam search (dequant fused into the matmuls
+    inside the while_loop), token-exact vs its own eager beam."""
+    from paddle_tpu.text import GPTConfig, GPTForCausalLM
+    from paddle_tpu.text.generation import beam_search
+    from paddle_tpu.text.decode import jit_beam_search
+    pt.seed(7)
+    cfg = GPTConfig(vocab_size=96, hidden_size=48, num_layers=2,
+                    num_heads=4, max_position_embeddings=64,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    tensor_parallel=False)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    convert_to_weight_only(m, algo="weight_only_int8")
+    ids = pt.to_tensor(np.array([[5, 17, 40, 3]], np.int64))
+    want = beam_search(m, ids, beam_size=3, max_new_tokens=6).numpy()
+    got = jit_beam_search(m, ids, beam_size=3, max_new_tokens=6).numpy()
+    np.testing.assert_array_equal(got, want)
