@@ -141,3 +141,24 @@ def test_generate_routes_num_beams():
     import pytest as _pt
     with _pt.raises(NotImplementedError, match="compose"):
         generate(m, ids, num_beams=2, do_sample=True)
+
+
+def test_moe_gpt_decodes_through_jitted_paths():
+    """MoE blocks (GShard static-capacity dispatch) compose with the
+    preallocated-cache decode loop AND the jitted beam search —
+    greedy jit decode is token-exact vs the eager loop."""
+    from paddle_tpu.text.generation import generate
+    from paddle_tpu.text.decode import jit_beam_search
+    pt.seed(5)
+    cfg = GPTConfig(vocab_size=96, hidden_size=48, num_layers=2,
+                    num_heads=4, max_position_embeddings=64,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    tensor_parallel=False, num_experts=4, moe_top_k=2)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = pt.to_tensor(np.array([[5, 17, 40, 3], [9, 8, 7, 6]], np.int64))
+    eager = generate(m, ids, max_new_tokens=8).numpy()
+    jit = jit_generate(m, ids, max_new_tokens=8).numpy()
+    np.testing.assert_array_equal(eager, jit)
+    beam = jit_beam_search(m, ids, beam_size=3, max_new_tokens=6)
+    assert tuple(beam.shape) == (2, 10)
