@@ -148,7 +148,7 @@ def test_moe_gpt_decodes_through_jitted_paths():
     preallocated-cache decode loop AND the jitted beam search —
     greedy jit decode is token-exact vs the eager loop."""
     from paddle_tpu.text.generation import generate
-    from paddle_tpu.text.decode import jit_beam_search
+    from paddle_tpu.text.decode import jit_beam_search, jit_generate
     pt.seed(5)
     cfg = GPTConfig(vocab_size=96, hidden_size=48, num_layers=2,
                     num_heads=4, max_position_embeddings=64,
