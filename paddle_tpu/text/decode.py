@@ -83,6 +83,32 @@ def _truncate_at_eos(out, prompt_len, eos_token_id):
     return host[:, :prompt_len + int(first.max()) + 1]
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _eval_mode(*models):
+    """Temporarily switch models to eval; restore train flags on exit."""
+    states = [m.training for m in models]
+    for m in models:
+        m.eval()
+    try:
+        yield
+    finally:
+        for m, was in zip(models, states):
+            if was:
+                m.train()
+
+
+def _decode_state(model, batch, max_length):
+    """split_state + preallocated-cache arrays for a jitted decode."""
+    pn, p_arrays, bn, b_arrays = FB.split_state(model)
+    proto = model.new_caches(batch, dtype=p_arrays[0].dtype,
+                             max_length=max_length)
+    caches = [(c["k"]._array, c["v"]._array) for c in proto]
+    return pn, p_arrays, bn, b_arrays, caches
+
+
 def _model_step(model, pn, bn, p_arrays, b_arrays, ids, cache_arrays, pos):
     """One functional forward over the preallocated caches."""
     caches = [{"k": Tensor._from_array(ck), "v": Tensor._from_array(cv),
@@ -101,15 +127,11 @@ def jit_generate(model, input_ids, max_new_tokens=20, do_sample=False,
     """Compile prefill + decode into one XLA program; returns
     [b, prompt + max_new_tokens] ids (positions after eos hold eos)."""
     from ..framework import random as _random
-    was_training = model.training
-    model.eval()
-    try:
-        pn, p_arrays, bn, b_arrays = FB.split_state(model)
-        b, prompt_len = input_ids.shape
-        total = prompt_len + max_new_tokens
-        dtype = p_arrays[0].dtype
-        proto = model.new_caches(b, dtype=dtype, max_length=total)
-        cache_arrays = [(c["k"]._array, c["v"]._array) for c in proto]
+    b, prompt_len = input_ids.shape
+    total = prompt_len + max_new_tokens
+    with _eval_mode(model):
+        pn, p_arrays, bn, b_arrays, cache_arrays = _decode_state(
+            model, b, total)
         key = seed_key if seed_key is not None else _random.next_key()
 
         cache_key = (prompt_len, max_new_tokens, bool(do_sample),
@@ -168,9 +190,6 @@ def jit_generate(model, input_ids, max_new_tokens=20, do_sample=False,
         if eos_token_id is not None:
             out = _truncate_at_eos(out, prompt_len, eos_token_id)
         return Tensor._from_array(jnp.asarray(out))
-    finally:
-        if was_training:
-            model.train()
 
 
 def jit_beam_search(model, input_ids, beam_size=4, max_new_tokens=20,
@@ -190,13 +209,9 @@ def jit_beam_search(model, input_ids, beam_size=4, max_new_tokens=20,
     b, prompt_len = input_ids.shape
     bb = b * beam
     total = prompt_len + max_new_tokens
-    was_training = model.training
-    model.eval()
-    try:
-        pn, p_arrays, bn, b_arrays = FB.split_state(model)
-        proto = model.new_caches(bb, dtype=p_arrays[0].dtype,
-                                 max_length=total)
-        cache_arrays = [(c["k"]._array, c["v"]._array) for c in proto]
+    with _eval_mode(model):
+        pn, p_arrays, bn, b_arrays, cache_arrays = _decode_state(
+            model, bb, total)
 
         ckey = ("beam", prompt_len, max_new_tokens, beam,
                 float(length_penalty), eos_token_id, b)
@@ -288,9 +303,6 @@ def jit_beam_search(model, input_ids, beam_size=4, max_new_tokens=20,
         fn = _lru_compiled(jcache, ckey, _build)
         out = fn(p_arrays, b_arrays, input_ids._array, cache_arrays)
         return Tensor._from_array(out)
-    finally:
-        if was_training:
-            model.train()
 
 
 def speculative_generate(model, draft_model, input_ids, max_new_tokens=20,
@@ -336,18 +348,11 @@ def speculative_generate(model, draft_model, input_ids, max_new_tokens=20,
     b, prompt_len = input_ids.shape
     total = prompt_len + max_new_tokens
 
-    was_t, was_d = model.training, draft_model.training
-    model.eval()
-    draft_model.eval()
-    try:
-        pn_t, p_t, bn_t, b_t = FB.split_state(model)
-        pn_d, p_d, bn_d, b_d = FB.split_state(draft_model)
-        proto_t = model.new_caches(b, dtype=p_t[0].dtype,
-                                   max_length=total + k + 1)
-        proto_d = draft_model.new_caches(b, dtype=p_d[0].dtype,
-                                         max_length=total + k + 1)
-        cache_t = [(c["k"]._array, c["v"]._array) for c in proto_t]
-        cache_d = [(c["k"]._array, c["v"]._array) for c in proto_d]
+    with _eval_mode(model, draft_model):
+        pn_t, p_t, bn_t, b_t, cache_t = _decode_state(model, b,
+                                                      total + k + 1)
+        pn_d, p_d, bn_d, b_d, cache_d = _decode_state(draft_model, b,
+                                                      total + k + 1)
         key = seed_key if seed_key is not None else _random.next_key()
 
         # the compiled program closes over BOTH modules' structures, so
@@ -503,8 +508,3 @@ def speculative_generate(model, draft_model, input_ids, max_new_tokens=20,
             out = jnp.asarray(
                 _truncate_at_eos(out, prompt_len, eos_token_id))
         return Tensor._from_array(out)
-    finally:
-        if was_t:
-            model.train()
-        if was_d:
-            draft_model.train()

@@ -103,23 +103,22 @@ class LoRALinear(nn.Layer):
             h = nn.functional.dropout(h, p=self._dropout_p)
         return y + (h @ self.lora_A) @ self.lora_B * self.scaling
 
+    def _delta(self):
+        return (self.lora_A._array @ self.lora_B._array) * self.scaling
+
     def merge(self):
         """Fold the adapter into the base weight (serving path)."""
         if self.merged:
             return
-        delta = (self.lora_A._array @ self.lora_B._array) * self.scaling
-        self.base.weight._inplace_assign(
-            self.base.weight._array + delta.astype(
-                self.base.weight._array.dtype))
+        w = self.base.weight
+        w._inplace_assign(w._array + self._delta().astype(w._array.dtype))
         self.merged = True
 
     def unmerge(self):
         if not self.merged:
             return
-        delta = (self.lora_A._array @ self.lora_B._array) * self.scaling
-        self.base.weight._inplace_assign(
-            self.base.weight._array - delta.astype(
-                self.base.weight._array.dtype))
+        w = self.base.weight
+        w._inplace_assign(w._array - self._delta().astype(w._array.dtype))
         self.merged = False
 
     def extra_repr(self):

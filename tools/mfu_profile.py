@@ -33,7 +33,6 @@ def run_variant(preset, seq, batch, steps, trace=False, cpu=False):
     import jax
     if cpu:
         jax.config.update("jax_platforms", "cpu")
-    import numpy as np
     import paddle_tpu as pt
     from paddle_tpu.text import GPTConfig, GPTForCausalLM, gpt_loss_fn
 
