@@ -205,3 +205,32 @@ def test_lora_wraps_tensor_parallel_linears():
     after = _snapshot(lora.model, lambda n: "lora_" not in n)
     for n in before:
         np.testing.assert_array_equal(before[n], after[n], err_msg=n)
+
+
+def test_lora_checkpoint_resume_with_empty_slots(tmp_path):
+    """save_state/load_state round-trip a LoRA engine whose optimizer
+    state holds EMPTY dicts for frozen params — the resumed step is
+    bit-identical."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.framework.checkpoint import save_state, load_state
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8, "mp_degree": 1,
+                               "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    lora = LoRAModel(_gpt(41), LoRAConfig(r=2))
+    opt = pt.optimizer.AdamW(learning_rate=1e-2,
+                             parameters=lora.trainable_parameters())
+    step = fleet.build_train_step(lora, gpt_loss_fn, opt)
+    ids = pt.randint(0, 64, [8, 16])
+    for _ in range(3):
+        step(ids, ids)
+    path = str(tmp_path / "ck")
+    save_state(path, model=lora, optimizer=step)
+    want = float(step(ids, ids))
+    lora2 = LoRAModel(_gpt(41), LoRAConfig(r=2))
+    opt2 = pt.optimizer.AdamW(learning_rate=1e-2,
+                              parameters=lora2.trainable_parameters())
+    step2 = fleet.build_train_step(lora2, gpt_loss_fn, opt2)
+    load_state(path, model=lora2, optimizer=step2)
+    got = float(step2(ids, ids))
+    assert abs(want - got) < 1e-5, (want, got)
