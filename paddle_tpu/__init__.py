@@ -34,6 +34,7 @@ from . import vision  # noqa: F401
 from . import text  # noqa: F401
 from . import linalg  # noqa: F401
 from . import static  # noqa: F401
+from . import observability  # noqa: F401
 from . import profiler  # noqa: F401
 from . import utils  # noqa: F401
 from . import metric  # noqa: F401

@@ -9,11 +9,67 @@ TPU-native double life:
 """
 from __future__ import annotations
 
+import inspect
+import time
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from ..tensor import Tensor
+
+# Telemetry sink (observability.enable() installs a _CommsTelemetry;
+# None means disabled — collectives then run with zero accounting cost).
+_TELEMETRY = None
+
+
+def _payload_nbytes(x):
+    """Payload size of a tensor / array / tracer / list thereof.  Works on
+    tracers too (shape+dtype are abstract-value facts), so collectives
+    inside shard_map are accounted once per trace."""
+    if isinstance(x, Tensor):
+        x = x._array
+    if isinstance(x, (list, tuple)):
+        return sum(_payload_nbytes(v) for v in x)
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n * getattr(dtype, "itemsize", 1)
+
+
+def _accounted(payload_arg):
+    """Decorator: record (op, payload bytes, mesh axis, wall time) per call
+    when telemetry is on.  `payload_arg` names the parameter carrying the
+    payload; the axis comes from `group` (or `axis_name` for ppermute)."""
+    def deco(fn):
+        import functools
+        sig = inspect.signature(fn)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            tel = _TELEMETRY
+            if tel is None:
+                return fn(*args, **kwargs)
+            try:
+                bound = sig.bind(*args, **kwargs)
+                payload = bound.arguments.get(payload_arg)
+                axis = bound.arguments.get("axis_name") or _axis(
+                    bound.arguments.get("group"))
+            except TypeError:
+                payload, axis = None, "?"
+            nbytes = _payload_nbytes(payload)
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                tel.record(fn.__name__, nbytes, axis, t0,
+                           time.perf_counter() - t0)
+        return wrapper
+    return deco
 
 
 class ReduceOp:
@@ -105,6 +161,7 @@ def _mp_collective(arr, op):
     return jnp.asarray(_mp_jitted(op)(g))
 
 
+@_accounted("tensor")
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     axis = _axis(group)
     fn = {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax,
@@ -120,6 +177,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     return fn(tensor, axis)
 
 
+@_accounted("tensor")
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     axis = _axis(group)
     arr = tensor._array if isinstance(tensor, Tensor) else tensor
@@ -145,6 +203,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
         return gathered
 
 
+@_accounted("input_list_or_tensor")
 def reduce_scatter(output, input_list_or_tensor, op=ReduceOp.SUM, group=None):
     axis = _axis(group)
     arr = input_list_or_tensor._array if isinstance(
@@ -159,6 +218,7 @@ def reduce_scatter(output, input_list_or_tensor, op=ReduceOp.SUM, group=None):
     return out
 
 
+@_accounted("tensor")
 def broadcast(tensor, src=0, group=None, sync_op=True):
     if jax.process_count() > 1 and isinstance(tensor, Tensor):
         n_local = jax.local_device_count()
@@ -175,6 +235,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None):
     return tensor
 
 
+@_accounted("in_tensor_list")
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     """Each rank i sends in_tensor_list[j] to rank j (reference:
     paddle.distributed.alltoall over NCCL — the expert-parallel transport).
@@ -211,6 +272,7 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     return wrapped
 
 
+@_accounted("in_tensor")
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
     """alltoall on one tensor split evenly along dim 0."""
@@ -242,6 +304,7 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
 _P2P_LOOPBACK = []
 
 
+@_accounted("tensor")
 def send(tensor, dst=0, group=None):
     axis = _axis(group)
     if _in_shard_map(axis):
@@ -272,6 +335,7 @@ def _p2p_world_check():
             "broadcast/all_gather which every rank enters")
 
 
+@_accounted("tensor")
 def recv(tensor, src=0, group=None):
     axis = _axis(group)
     if _in_shard_map(axis):
@@ -297,6 +361,7 @@ def recv(tensor, src=0, group=None):
     return Tensor._from_array(arr)
 
 
+@_accounted("x")
 def ppermute(x, axis_name, perm):
     arr = x._array if isinstance(x, Tensor) else x
     out = lax.ppermute(arr, axis_name, perm)

@@ -44,6 +44,13 @@ class _Fleet:
                             pp=int(hc.get("pp_degree", 1) or 1),
                             mp=int(hc.get("mp_degree", 1) or 1),
                             ep=int(hc.get("ep_degree", 1) or 1))
+        # topology gauges, set eagerly (the observability mesh collector
+        # also refreshes them at every export, so enable() order doesn't
+        # matter)
+        from ... import observability as _obs
+        reg = _obs.metrics.registry()
+        for ax in ("dp", "mp", "pp", "ep"):
+            reg.gauge("mesh_axis_degree", axis=ax).set(mesh_mod.degree(ax))
         self._initialized = True
         return self
 

@@ -15,12 +15,16 @@ Everything is donated, so weights/optimizer state update in place in HBM.
 """
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import observability as _obs
 from ..autograd import engine as _engine
+from ..observability import compile_tracker as _ct
 from ..jit import functional_bridge as FB
 from ..framework import random as _random
 from ..tensor import Tensor
@@ -721,8 +725,29 @@ class DistributedTrainStep:
         lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
         step = jnp.asarray(self._step, jnp.float32)
         rng = _random.next_key()
-        loss, new_params, new_buffers, self._opt_state, finite = self._jitted(
-            param_tree, ba, self._opt_state, lr, step, rng, batch_arrays)
+        tok = t0 = None
+        if _obs.enabled():
+            tok = _ct.on_call(
+                f"DistributedTrainStep({type(model).__name__})",
+                _ct.signature_of(
+                    jax.tree_util.tree_leaves(param_tree) + list(ba) +
+                    list(batch_arrays)),
+                owner=self)
+            t0 = time.perf_counter()
+        try:
+            loss, new_params, new_buffers, self._opt_state, finite = \
+                self._jitted(param_tree, ba, self._opt_state, lr, step,
+                             rng, batch_arrays)
+        except BaseException:
+            if tok is not None:
+                _ct.abort(tok)
+            raise
+        if tok is not None:
+            _ct.finish(tok)
+        if t0 is not None:
+            _obs.trace.add_complete("fleet_step", "step", t0,
+                                    time.perf_counter() - t0,
+                                    args={"step": self._step})
         if finite is not None:
             from ..framework import debugging as _dbg
             _dbg.raise_on_nonfinite(

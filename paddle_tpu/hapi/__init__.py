@@ -15,7 +15,8 @@ from .. import io as _io
 from ..metric import Metric
 from ..tensor import Tensor
 from . import callbacks as callbacks_mod
-from .callbacks import Callback, CallbackList, ProgBarLogger, ModelCheckpoint
+from .callbacks import (Callback, CallbackList, MetricsLogger,
+                        ProgBarLogger, ModelCheckpoint)
 
 __all__ = ["Model"]
 
@@ -217,36 +218,46 @@ class Model:
         cblist = CallbackList(cbs, self, {
             "epochs": epochs, "steps": steps, "verbose": verbose})
 
-        cblist.call("on_train_begin", {})
         history = []
-        for epoch in range(epochs):
-            if self.stop_training:
-                break
-            cblist.call("on_epoch_begin", epoch, {})
-            self.network.train()
-            losses = []
-            for step, batch in enumerate(loader):
-                batch = batch if isinstance(batch, (list, tuple)) else [batch]
-                cblist.call("on_train_batch_begin", step, {})
-                loss = self._train_step(*batch)
-                # keep the loss on device: a float() here would block on the
-                # async XLA dispatch every batch.  Materialize only at log
-                # boundaries; the epoch mean syncs once at epoch end.
-                losses.append(loss._array)
-                logs = {"loss": float(loss)} \
-                    if (step + 1) % log_freq == 0 else {}
-                cblist.call("on_train_batch_end", step, logs)
-            epoch_logs = {"loss": float(np.mean([np.asarray(a)
-                                                 for a in losses]))
-                          if losses else 0.0}
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self.evaluate(eval_loader, batch_size=batch_size,
-                                          verbose=0, callbacks=cbs,
-                                          _cblist=cblist)
-                epoch_logs.update({f"eval_{k}": v
-                                   for k, v in eval_logs.items()})
-            cblist.call("on_epoch_end", epoch, epoch_logs)
-            history.append(epoch_logs)
+        try:
+            cblist.call("on_train_begin", {})
+            for epoch in range(epochs):
+                if self.stop_training:
+                    break
+                cblist.call("on_epoch_begin", epoch, {})
+                self.network.train()
+                losses = []
+                for step, batch in enumerate(loader):
+                    batch = batch if isinstance(batch, (list, tuple)) \
+                        else [batch]
+                    cblist.call("on_train_batch_begin", step, {})
+                    loss = self._train_step(*batch)
+                    # keep the loss on device: a float() here would block
+                    # on the async XLA dispatch every batch.  Materialize
+                    # only at log boundaries; the epoch mean syncs once at
+                    # epoch end.
+                    losses.append(loss._array)
+                    logs = {"loss": float(loss)} \
+                        if (step + 1) % log_freq == 0 else {}
+                    cblist.call("on_train_batch_end", step, logs)
+                epoch_logs = {"loss": float(np.mean([np.asarray(a)
+                                                     for a in losses]))
+                              if losses else 0.0}
+                if eval_loader is not None and \
+                        (epoch + 1) % eval_freq == 0:
+                    eval_logs = self.evaluate(eval_loader,
+                                              batch_size=batch_size,
+                                              verbose=0, callbacks=cbs,
+                                              _cblist=cblist)
+                    epoch_logs.update({f"eval_{k}": v
+                                       for k, v in eval_logs.items()})
+                cblist.call("on_epoch_end", epoch, epoch_logs)
+                history.append(epoch_logs)
+        except BaseException:
+            # telemetry/profiler callbacks must release global state even
+            # when a step raises (nonfinite loss, OOM, ^C)
+            cblist.call_safe("on_train_error", {})
+            raise
         cblist.call("on_train_end", {})
         return history
 

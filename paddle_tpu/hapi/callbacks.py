@@ -9,7 +9,7 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler"]
+           "LRScheduler", "MetricsLogger"]
 
 
 class Callback:
@@ -23,6 +23,7 @@ class Callback:
 
     def on_train_begin(self, logs=None): ...
     def on_train_end(self, logs=None): ...
+    def on_train_error(self, logs=None): ...   # fit() raised mid-training
     def on_eval_begin(self, logs=None): ...
     def on_eval_end(self, logs=None): ...
     def on_predict_begin(self, logs=None): ...
@@ -47,6 +48,16 @@ class CallbackList:
     def call(self, hook, *args):
         for c in self.callbacks:
             getattr(c, hook)(*args)
+
+    def call_safe(self, hook, *args):
+        """Best-effort hook dispatch for error-path cleanup: one
+        callback's failure must neither mask the original training error
+        nor starve later callbacks of their cleanup."""
+        for c in self.callbacks:
+            try:
+                getattr(c, hook)(*args)
+            except Exception:
+                pass
 
 
 class ProgBarLogger(Callback):
@@ -144,6 +155,121 @@ class EarlyStopping(Callback):
             self.wait += 1
             if self.wait >= self.patience:
                 self.model.stop_training = True
+
+
+class MetricsLogger(Callback):
+    """Telemetry bridge for Model.fit: feeds the observability layer and
+    (optionally) exports the merged Chrome trace of the run.
+
+    Per train batch it records a step-time histogram and a step span; per
+    epoch it adds step-time percentiles (p50/p90/p99), throughput
+    (steps/s, and samples/s when batch_size is given), and a
+    jax.live_arrays()-based device-memory gauge to the epoch logs (so they
+    land in fit()'s history).  At train end it writes the Chrome trace —
+    step, compile, comms, and RecordEvent spans on one timeline — to
+    `trace_path`, loadable in chrome://tracing or Perfetto.
+
+    If telemetry is not already on, it is enabled for the duration of the
+    fit.  An optional `profiler` (paddle_tpu.profiler.Profiler) is driven
+    alongside (start / per-batch step / stop) so a device xplane capture
+    window rides the same run.
+    """
+
+    def __init__(self, registry=None, trace_path=None, batch_size=None,
+                 profiler=None):
+        self._registry = registry
+        self.trace_path = trace_path
+        self.batch_size = batch_size
+        self.profiler = profiler
+        self._owns_telemetry = False
+
+    def on_train_begin(self, logs=None):
+        from .. import observability as obs
+        self._obs = obs
+        if not obs.enabled():
+            obs.enable(self._registry)
+            self._owns_telemetry = True
+        self._reg = self._registry or obs.metrics.registry()
+        self._hist = self._reg.histogram("fit_step_seconds")
+        self._steps = self._reg.counter("fit_steps_total")
+        self._mem = self._reg.gauge("live_array_bytes")
+        self._t0 = None
+        # export only THIS run's spans: a second fit in the same process
+        # must not replay the previous run's timeline
+        self._trace_mark = obs.trace.mark()
+        if self.profiler is not None:
+            self.profiler.start()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch_t0 = time.perf_counter()
+        self._epoch_last_t = self._epoch_t0
+        self._epoch_steps = 0
+        # fresh per-epoch histogram: the logged percentiles must describe
+        # THIS epoch, not accumulate prior epochs/runs (the registry
+        # histogram stays cumulative for scraping)
+        self._epoch_hist = self._obs.metrics.Histogram()
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._t0 = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._t0 is None:
+            return
+        dt = time.perf_counter() - self._t0
+        self._hist.observe(dt)
+        self._epoch_hist.observe(dt)
+        self._steps.inc()
+        self._epoch_steps += 1
+        self._epoch_last_t = time.perf_counter()
+        self._obs.trace.add_complete("train_step", "step", self._t0, dt,
+                                     args={"step": step})
+        if self.profiler is not None:
+            self.profiler.step(num_samples=self.batch_size)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is None:
+            return
+        h = self._epoch_hist
+        for name, p in (("step_time_p50", 50), ("step_time_p90", 90),
+                        ("step_time_p99", 99)):
+            v = h.percentile(p)
+            if v is not None:
+                logs[name] = v
+                self._reg.gauge(f"fit_{name}_seconds").set(v)
+        # up to the LAST train batch: fit runs evaluate() and the epoch
+        # host sync before this hook, which must not deflate throughput
+        dt_epoch = self._epoch_last_t - self._epoch_t0
+        if self._epoch_steps and dt_epoch > 0:
+            logs["steps_per_s"] = self._epoch_steps / dt_epoch
+            if self.batch_size:
+                logs["samples_per_s"] = (self._epoch_steps *
+                                         self.batch_size / dt_epoch)
+        try:
+            import jax
+            mem = sum(int(a.nbytes) for a in jax.live_arrays())
+        except Exception:
+            mem = None
+        if mem is not None:
+            self._mem.set(mem)
+            logs["live_array_bytes"] = mem
+
+    def on_train_end(self, logs=None):
+        if getattr(self, "_obs", None) is None:
+            return   # on_train_begin never ran (a callback before us
+                     # failed): nothing to release
+        if self.profiler is not None:
+            self.profiler.stop()
+        if self.trace_path:
+            self._obs.trace.export_chrome_trace(self.trace_path,
+                                                since=self._trace_mark)
+        if self._owns_telemetry:
+            self._obs.disable()
+            self._owns_telemetry = False
+
+    # a crash mid-fit must not leak globally-enabled telemetry or an open
+    # device trace; the partial Chrome trace is exported — it is exactly
+    # what diagnoses the crash
+    on_train_error = on_train_end
 
 
 class LRScheduler(Callback):

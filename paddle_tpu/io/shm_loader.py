@@ -23,6 +23,7 @@ import pickle
 import signal
 import tempfile
 import threading
+import time
 import traceback
 
 import numpy as np
@@ -312,9 +313,16 @@ class ShmWorkerPool:
         return not self._procs[self._rings.index(ring)].is_alive()
 
     def __iter__(self):
+        from .. import observability as _obs
+        depth_gauge = wait_hist = None
+        if _obs.enabled():
+            reg = _obs.metrics.registry()
+            depth_gauge = reg.gauge("loader_queue_depth")
+            wait_hist = reg.histogram("loader_batch_wait_seconds")
         live = list(self._rings)
         w = 0
         waited_ms = 0
+        wait_t0 = time.perf_counter()
         try:
             while live:
                 ring = live[w % len(live)]
@@ -340,8 +348,16 @@ class ShmWorkerPool:
                         raise exc from RuntimeError(
                             "DataLoader worker failed:\n" + tb)
                     raise RuntimeError("DataLoader worker failed:\n" + tb)
+                if wait_hist is not None:
+                    # time from requesting this batch until it was read,
+                    # and how many workers have another batch ready (queue
+                    # depth: 0 means the consumer is data-starved)
+                    wait_hist.observe(time.perf_counter() - wait_t0)
+                    depth_gauge.set(sum(1 for r in live
+                                        if r.next_len(0) >= 0))
                 yield pickle.loads(payload[1:])
                 w += 1
+                wait_t0 = time.perf_counter()
         finally:
             self.shutdown()
 

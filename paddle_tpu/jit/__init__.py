@@ -15,8 +15,10 @@ import pickle
 import jax
 import jax.numpy as jnp
 
+from .. import observability as _obs
 from ..autograd import engine
 from ..framework import random as _random
+from ..observability import compile_tracker as _ct
 from ..tensor import Tensor
 from ..nn.layer import Layer
 from . import functional_bridge as FB
@@ -122,7 +124,24 @@ class StaticFunction:
         pure, key = self._build_pure(layer.training, static_kwargs,
                                      in_treedef, len(in_tensors))
         all_inputs = params + buffers + [rng] + in_tensors
-        result = engine.apply("to_static", pure, all_inputs)
+        tok = None
+        if _obs.enabled():
+            tok = _ct.on_call(
+                f"to_static({type(layer).__name__})",
+                _ct.signature_of(
+                    [t._array for t in all_inputs],
+                    static=(layer.training,
+                            tuple(sorted(static_kwargs.items())),
+                            in_treedef)),
+                owner=self)
+        try:
+            result = engine.apply("to_static", pure, all_inputs)
+        except BaseException:
+            if tok is not None:
+                _ct.abort(tok)
+            raise
+        if tok is not None:
+            _ct.finish(tok)
         result = result if isinstance(result, tuple) else (result,)
         out_treedef, n_out = self._out_treedef[key]
         outs = [t for t in result[:n_out]]
@@ -206,7 +225,21 @@ def _static_fn(fn, while_max_iters=None):
             state = (jax.jit(pure), out_info)
             cache[key] = state
         pure, out_info = state
-        result = engine.apply("to_static_fn", pure, in_tensors)
+        tok = None
+        if _obs.enabled():
+            tok = _ct.on_call(
+                f"to_static_fn({getattr(fn, '__qualname__', '?')})",
+                _ct.signature_of([t._array for t in in_tensors],
+                                 static=(in_treedef, statics)),
+                owner=cache)
+        try:
+            result = engine.apply("to_static_fn", pure, in_tensors)
+        except BaseException:
+            if tok is not None:
+                _ct.abort(tok)
+            raise
+        if tok is not None:
+            _ct.finish(tok)
         result = result if isinstance(result, tuple) else (result,)
         return jax.tree_util.tree_unflatten(out_info["td"], list(result))
 

@@ -34,6 +34,7 @@ import functools
 import inspect
 import sys
 import textwrap
+import time
 
 import jax
 import jax.numpy as jnp
@@ -777,6 +778,7 @@ def convert_to_static(fn):
         return fn, False
     if not inspect.isfunction(raw):
         return fn, False
+    t0 = time.perf_counter()
     try:
         src = textwrap.dedent(inspect.getsource(raw))
         tree = ast.parse(src)
@@ -808,4 +810,10 @@ def convert_to_static(fn):
     new_fn.__defaults__ = raw.__defaults__
     new_fn.__kwdefaults__ = raw.__kwdefaults__
     functools.update_wrapper(new_fn, raw)
+    from .. import observability as _obs
+    if _obs.enabled():
+        qn = getattr(raw, "__qualname__", "?")
+        _obs.trace.add_complete(f"dy2static:{qn}", "compile", t0,
+                                time.perf_counter() - t0)
+        _obs.metrics.registry().counter("dy2static_conversions_total").inc()
     return new_fn, True

@@ -11,7 +11,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .. import observability as _obs
 from ..framework import random as _random
+from ..observability import compile_tracker as _ct
 from ..tensor import Tensor
 from . import functional_bridge as FB
 
@@ -101,8 +103,22 @@ class TrainStep:
         batch_arrays = tuple(
             b._array if isinstance(b, Tensor) else jnp.asarray(b)
             for b in batch)
-        loss, new_params, new_buffers, self._opt_state, finite = self._jitted(
-            pa, ba, self._opt_state, lr, step, rng, batch_arrays)
+        tok = None
+        if _obs.enabled():
+            tok = _ct.on_call(
+                f"TrainStep({type(model).__name__})",
+                _ct.signature_of(list(pa) + list(ba) + list(batch_arrays)),
+                owner=self)
+        try:
+            loss, new_params, new_buffers, self._opt_state, finite = \
+                self._jitted(pa, ba, self._opt_state, lr, step, rng,
+                             batch_arrays)
+        except BaseException:
+            if tok is not None:
+                _ct.abort(tok)
+            raise
+        if tok is not None:
+            _ct.finish(tok)
         if finite is not None:
             from ..framework import debugging as _dbg
             _dbg.raise_on_nonfinite(finite, pn, self._step)

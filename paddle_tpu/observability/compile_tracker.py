@@ -1,0 +1,213 @@
+"""Compile tracking for the jit entry points.
+
+Every jitted entry (TrainStep, to_static, DistributedTrainStep) reports an
+abstract call signature per invocation; a signature never seen for that
+function means jax.jit is about to trace+lower+compile.  The tracker
+records the compile event (wall time, cause) and diagnoses WHY a
+recompile happened — shape change vs dtype change vs new static arg — the
+question a perf round asks first when step time regresses.  After
+`warn_after` distinct compilations of the same function it raises a
+RecompileWarning naming the cause, the host-side analog of the
+reference's dy2static re-tracing warnings.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+import weakref
+
+
+class RecompileWarning(UserWarning):
+    """A jitted function keeps recompiling (shape/dtype/static-arg churn)."""
+
+
+class CompileEvent:
+    __slots__ = ("label", "cause", "wall_s", "ts", "index")
+
+    def __init__(self, label, cause, wall_s, ts, index):
+        self.label = label          # function identity, e.g. TrainStep(Net)
+        self.cause = cause          # "first compile" / "shape change" / ...
+        self.wall_s = wall_s        # trace+lower+compile+first-run wall time
+        self.ts = ts                # perf_counter at call start
+        self.index = index          # 1-based compile count for this label
+
+    def __repr__(self):
+        return (f"CompileEvent({self.label!r}, cause={self.cause!r}, "
+                f"wall_s={self.wall_s:.3f}, n={self.index})")
+
+
+class _FnRecord:
+    """Per-(owner, label) state: O(1) hash-set membership for the hot
+    path, plus the last full signature for cause diagnosis."""
+
+    __slots__ = ("hashes", "last", "count")
+
+    def __init__(self):
+        self.hashes = set()
+        self.last = None
+        self.count = 0
+
+
+_lock = threading.Lock()
+_seen: dict = {}      # (owner id, label) -> _FnRecord
+_events: list = []
+_warn_after = 5
+
+
+def _drop_key(key):
+    with _lock:
+        _seen.pop(key, None)
+
+
+def set_warn_after(n):
+    global _warn_after
+    _warn_after = int(n)
+
+
+def signature_of(arrays, static=()):
+    """Abstract signature: ((shape, dtype) per array leaf, static part).
+
+    dtype objects are kept as-is (hashable, comparable) — no per-leaf
+    str() on the telemetry-enabled hot path.  `static` must be
+    hashable-after-repr (it is repr'd), covering python values that
+    specialize the trace (training flags, static kwargs)."""
+    leaves = []
+    for a in arrays:
+        d = getattr(a, "dtype", None)
+        leaves.append((tuple(getattr(a, "shape", ())),
+                       d if d is not None else type(a).__name__))
+    return (tuple(leaves), tuple(repr(s) for s in static))
+
+
+def diagnose(prev, new):
+    """Explain what changed between the previous and the new signature."""
+    if prev is None:
+        return "first compile"
+    p_arr, p_st = prev
+    n_arr, n_st = new
+    if p_st != n_st:
+        return "new static arg"
+    if len(p_arr) != len(n_arr):
+        return "arity change"
+    shape_changed = any(ps != ns for (ps, _), (ns, _) in zip(p_arr, n_arr))
+    dtype_changed = any(pd != nd for (_, pd), (_, nd) in zip(p_arr, n_arr))
+    if shape_changed and dtype_changed:
+        return "shape+dtype change"
+    if shape_changed:
+        return "shape change"
+    if dtype_changed:
+        return "dtype change"
+    return "recompile (unknown cause)"
+
+
+class _Token:
+    __slots__ = ("label", "cause", "index", "t0", "key", "sig_hash",
+                 "prev_last")
+
+    def __init__(self, label, cause, index, t0, key, sig_hash, prev_last):
+        self.label = label
+        self.cause = cause
+        self.index = index
+        self.t0 = t0
+        self.key = key
+        self.sig_hash = sig_hash
+        self.prev_last = prev_last
+
+
+def on_call(label, sig, owner=None):
+    """Report an invocation.  Returns a token when this signature is new
+    for (`owner`, `label`) (a compile will happen — pass the token to
+    finish() after the call, or abort() if the call raises); returns None
+    on a cache hit.  `owner` distinguishes instances sharing a label
+    (two TrainSteps over same-named models each have their own jit
+    cache); the tracked key is its id, auto-pruned via weakref when the
+    owner is collected (non-weakrefable owners stay until reset())."""
+    key = (id(owner), label)
+    h = hash(sig)
+    with _lock:
+        rec = _seen.get(key)
+        if rec is None:
+            rec = _seen[key] = _FnRecord()
+            if owner is not None:
+                try:
+                    weakref.finalize(owner, _drop_key, key)
+                except TypeError:
+                    pass   # e.g. a dict cache: lives as long as its jit
+        if h in rec.hashes:
+            return None
+        cause = diagnose(rec.last, sig)
+        rec.hashes.add(h)
+        prev_last, rec.last = rec.last, sig
+        rec.count += 1
+        index = rec.count
+    if index > _warn_after:
+        warnings.warn(
+            f"{label} compiled {index} times (latest cause: {cause}); "
+            f"recompilation dominates step time — stabilize input "
+            f"shapes/dtypes (pad/bucket batches) or hoist the changing "
+            f"python argument out of the jitted call",
+            RecompileWarning, stacklevel=3)
+    return _Token(label, cause, index, time.perf_counter(), key, h,
+                  prev_last)
+
+
+def abort(token):
+    """Roll back on_call after the jitted call raised: the compile may not
+    have completed, so the signature must not count as seen (the user's
+    retry after fixing inputs would otherwise be treated as a cache hit
+    and never recorded)."""
+    with _lock:
+        rec = _seen.get(token.key)
+        if rec is not None and token.sig_hash in rec.hashes:
+            rec.hashes.discard(token.sig_hash)
+            rec.count -= 1
+            rec.last = token.prev_last
+
+
+def finish(token):
+    """Close a compile event opened by on_call; records metrics + trace."""
+    wall = time.perf_counter() - token.t0
+    ev = CompileEvent(token.label, token.cause, wall, token.t0, token.index)
+    with _lock:
+        _events.append(ev)
+    from . import metrics, trace
+    reg = metrics.registry()
+    reg.counter("jit_compiles_total", fn=token.label).inc()
+    reg.counter("jit_recompiles_total", fn=token.label,
+                cause=token.cause).inc()
+    reg.histogram("jit_compile_seconds", fn=token.label).observe(wall)
+    trace.add_complete(f"compile:{token.label}", "compile", token.t0, wall,
+                       args={"cause": token.cause, "n": token.index})
+    return ev
+
+
+def aot_profile(jitted, *args, **kwargs):
+    """Split lowering vs compile wall time for a jax.jit'd callable via the
+    AOT API (offline analysis; does not share jit's dispatch cache)."""
+    t0 = time.perf_counter()
+    lowered = jitted.lower(*args, **kwargs)
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    t2 = time.perf_counter()
+    return {"lowering_s": t1 - t0, "compile_s": t2 - t1,
+            "compiled": compiled}
+
+
+def events(label=None):
+    with _lock:
+        evs = list(_events)
+    return [e for e in evs if e.label == label] if label else evs
+
+
+def compile_count(label):
+    """Total distinct compilations recorded for `label`, across owners."""
+    with _lock:
+        return sum(rec.count for (_, lb), rec in _seen.items()
+                   if lb == label)
+
+
+def reset():
+    with _lock:
+        _seen.clear()
+        _events.clear()

@@ -1,0 +1,271 @@
+"""Metrics registry: counters, gauges, histograms with reservoir percentiles.
+
+The reference ships fleet-level metrics through Paddle's monitor/stat
+registry (paddle/phi/core/flags.h stats + fleet metrics); here the registry
+is a process-local, thread-safe table keyed by (name, labels) that every
+telemetry source (dispatch counters, compile tracker, comms accounting,
+loader gauges, hapi MetricsLogger) writes into, exportable as JSON-lines
+(one metric per line, machine-diffable across BENCH rounds) and as
+Prometheus text exposition format (scrapeable when a serving frontend
+mounts it).
+"""
+from __future__ import annotations
+
+import json
+import math
+import random
+import threading
+
+
+# one shared lock for scalar read-modify-write: counters/gauges update at
+# export-collector and comms rates (not the dispatch hot path), so
+# contention is negligible and lost-increment interleavings are ruled out
+_VAL_LOCK = threading.Lock()
+
+
+class Counter:
+    """Monotonic counter (externally-collected counters may set totals)."""
+
+    kind = "counter"
+    __slots__ = ("_v",)
+
+    def __init__(self):
+        self._v = 0
+
+    def inc(self, n=1):
+        with _VAL_LOCK:
+            self._v += n
+
+    def _set_total(self, v):
+        """Collector hook: overwrite with an externally-accumulated total."""
+        self._v = v
+
+    @property
+    def value(self):
+        return self._v
+
+    def snapshot(self):
+        return {"value": self._v}
+
+
+class Gauge:
+    kind = "gauge"
+    __slots__ = ("_v",)
+
+    def __init__(self):
+        self._v = 0.0
+
+    def set(self, v):
+        self._v = v
+
+    def inc(self, n=1):
+        with _VAL_LOCK:
+            self._v += n
+
+    def dec(self, n=1):
+        with _VAL_LOCK:
+            self._v -= n
+
+    @property
+    def value(self):
+        return self._v
+
+    def snapshot(self):
+        return {"value": self._v}
+
+
+class Histogram:
+    """Streaming histogram with reservoir-sampled percentiles (algorithm R,
+    deterministic seed so exports are reproducible under a fixed workload).
+    """
+
+    kind = "histogram"
+    __slots__ = ("_n", "_sum", "_min", "_max", "_sample", "_k", "_rng",
+                 "_lock")
+
+    def __init__(self, reservoir=1024):
+        self._n = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+        self._sample = []
+        self._k = reservoir
+        self._rng = random.Random(0x5EED)
+        self._lock = threading.Lock()
+
+    def observe(self, v):
+        v = float(v)
+        with self._lock:
+            self._n += 1
+            self._sum += v
+            self._min = v if self._min is None else min(self._min, v)
+            self._max = v if self._max is None else max(self._max, v)
+            if len(self._sample) < self._k:
+                self._sample.append(v)
+            else:
+                j = self._rng.randrange(self._n)
+                if j < self._k:
+                    self._sample[j] = v
+
+    @property
+    def count(self):
+        return self._n
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def percentile(self, p):
+        """Nearest-rank percentile, p in [0, 100]; None when nothing was
+        observed."""
+        with self._lock:
+            sample = sorted(self._sample)
+        if not sample:
+            return None
+        idx = max(0, min(len(sample) - 1,
+                         math.ceil(p / 100.0 * len(sample)) - 1))
+        return sample[idx]
+
+    def snapshot(self):
+        out = {"count": self._n, "sum": self._sum}
+        if self._n:
+            out.update(min=self._min, max=self._max,
+                       p50=self.percentile(50), p90=self.percentile(90),
+                       p99=self.percentile(99))
+        return out
+
+
+class MetricsRegistry:
+    """Get-or-create table of metrics keyed by (name, sorted labels)."""
+
+    def __init__(self):
+        self._metrics = {}   # (name, labels_tuple) -> metric object
+        self._lock = threading.RLock()
+        self._collectors = []
+
+    # ------------------------------------------------------------ creation
+    def _get(self, cls, name, labels, **kwargs):
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(**kwargs)
+                self._metrics[key] = m
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r}{labels} already registered as "
+                    f"{m.kind}, requested {cls.kind}")
+            return m
+
+    def counter(self, name, **labels) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name, **labels) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name, reservoir=1024, **labels) -> Histogram:
+        return self._get(Histogram, name, labels, reservoir=reservoir)
+
+    # ----------------------------------------------------------- collectors
+    def add_collector(self, fn):
+        """fn(registry) runs before every export, materializing counters
+        accumulated outside the registry (e.g. the dispatch hot-path
+        Counter dict, which must not pay registry lookups per op call)."""
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+        return fn
+
+    def remove_collector(self, fn):
+        with self._lock:
+            if fn in self._collectors:
+                self._collectors.remove(fn)
+
+    def collect(self):
+        for fn in list(self._collectors):
+            fn(self)
+
+    # -------------------------------------------------------------- exports
+    def snapshot(self):
+        """[{name, type, labels, ...values}] — collectors run first."""
+        self.collect()
+        with self._lock:
+            items = sorted(self._metrics.items())
+        out = []
+        for (name, labels), m in items:
+            rec = {"name": name, "type": m.kind, "labels": dict(labels)}
+            rec.update(m.snapshot())
+            out.append(rec)
+        return out
+
+    def to_jsonl(self) -> str:
+        return "\n".join(json.dumps(rec, sort_keys=True)
+                         for rec in self.snapshot())
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition; histograms export as summaries."""
+        lines = []
+        typed = set()
+        for rec in self.snapshot():
+            name, kind, labels = rec["name"], rec["type"], rec["labels"]
+            if kind == "histogram":
+                if name not in typed:
+                    lines.append(f"# TYPE {name} summary")
+                    typed.add(name)
+                for q, key in (("0.5", "p50"), ("0.9", "p90"),
+                               ("0.99", "p99")):
+                    if rec.get(key) is not None:
+                        lines.append(f"{name}"
+                                     f"{_labels(labels, quantile=q)} "
+                                     f"{_num(rec[key])}")
+                lines.append(f"{name}_count{_labels(labels)} {rec['count']}")
+                lines.append(f"{name}_sum{_labels(labels)} "
+                             f"{_num(rec['sum'])}")
+            else:
+                if name not in typed:
+                    lines.append(f"# TYPE {name} {kind}")
+                    typed.add(name)
+                lines.append(f"{name}{_labels(labels)} {_num(rec['value'])}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self):
+        with self._lock:
+            self._metrics.clear()
+
+
+def _esc(v):
+    return str(v).replace("\\", r"\\").replace('"', r"\"").replace(
+        "\n", r"\n")
+
+
+def _labels(labels, **extra):
+    all_labels = dict(labels, **extra)
+    if not all_labels:
+        return ""
+    inner = ",".join(f'{k}="{_esc(v)}"'
+                     for k, v in sorted(all_labels.items()))
+    return "{" + inner + "}"
+
+
+def _num(v):
+    v = float(v)
+    return repr(int(v)) if v.is_integer() and abs(v) < 2**53 else repr(v)
+
+
+_default = MetricsRegistry()
+_active = _default
+
+
+def registry() -> MetricsRegistry:
+    """The ACTIVE registry every built-in instrument writes to — the
+    process default unless observability.enable(registry_=...) retargeted
+    it."""
+    return _active
+
+
+def set_registry(reg):
+    """Retarget the active registry (None restores the process default).
+    Returns the now-active registry."""
+    global _active
+    _active = reg if reg is not None else _default
+    return _active

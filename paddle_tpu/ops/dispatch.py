@@ -17,14 +17,21 @@ from ..autograd import engine
 # "deny" (compute in fp32 — numerically sensitive), "keep" (leave dtypes)
 _REGISTRY: dict = {}
 
+# Telemetry sink (observability.enable() installs a _DispatchTelemetry;
+# None means disabled).  The dispatch hot path pays exactly ONE global
+# load + None check when telemetry is off — no dict lookups, no closures.
+_TELEMETRY = None
+_OVERRIDDEN: set = set()   # ops whose impl was swapped (pallas kernels)
+
 
 class OpDef:
-    __slots__ = ("name", "fn", "amp")
+    __slots__ = ("name", "fn", "amp", "base_fn")
 
     def __init__(self, name, fn, amp):
         self.name = name
         self.fn = fn
         self.amp = amp
+        self.base_fn = fn   # the register()-time impl, for override bookkeeping
 
 
 def register(name, fn=None, amp="keep"):
@@ -38,9 +45,16 @@ def register(name, fn=None, amp="keep"):
 
 
 def override(name, fn):
-    """Swap an op's implementation (e.g. pallas flash-attention on TPU)."""
-    old = _REGISTRY[name].fn
-    _REGISTRY[name].fn = fn
+    """Swap an op's implementation (e.g. pallas flash-attention on TPU).
+    Restoring the register()-time impl takes the op back OFF the
+    override-hit books."""
+    op = _REGISTRY[name]
+    old = op.fn
+    op.fn = fn
+    if fn is op.base_fn:
+        _OVERRIDDEN.discard(name)
+    else:
+        _OVERRIDDEN.add(name)
     return old
 
 
@@ -72,6 +86,8 @@ def _amp_cast(tensors, policy, op_name=None):
             # apply the cast kernel directly (tape-recorded) rather than via
             # call(): re-dispatching would amp-cast the 'cast' op's own input
             # and recurse forever under O2.
+            if _TELEMETRY is not None:
+                _TELEMETRY.cast(op_name or "?")
             out.append(engine.apply("cast", cast_op.fn, [t],
                                     {"dtype": cast_to}))
         else:
@@ -82,6 +98,8 @@ def _amp_cast(tensors, policy, op_name=None):
 def call(name, *tensor_args, **consts):
     """Dispatch: amp-cast → autograd-recorded execution of the kernel."""
     op = _REGISTRY[name]
+    if _TELEMETRY is not None:
+        _TELEMETRY.op(name)
     if name != "cast":
         tensor_args = _amp_cast(list(tensor_args), op.amp, op_name=name)
     return engine.apply(name, op.fn, tensor_args, consts)

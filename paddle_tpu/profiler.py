@@ -15,6 +15,8 @@ import time
 
 import jax
 
+from . import observability as _obs
+
 _event_stats = collections.defaultdict(lambda: [0, 0.0, 0.0])  # n, tot, max
 
 
@@ -43,14 +45,35 @@ class RecordEvent:
         s[0] += 1
         s[1] += dt
         s[2] = max(s[2], dt)
+        if _obs.enabled():
+            _obs.trace.add_complete(self.name, "host", self.begin, dt)
         return False
 
 
+class _Schedule(tuple):
+    """Scheduler with repeated capture windows.  Subclasses tuple as the
+    first (lo, hi) window, so everything that treated make_scheduler's
+    result as a plain (start, end) pair keeps working."""
+
+    def __new__(cls, windows):
+        self = super().__new__(cls, windows[0])
+        self.windows = list(windows)
+        return self
+
+
 def make_scheduler(*, closed=0, ready=0, record=1, repeat=0, skip_first=0):
-    """Reference-style scheduler factory → (start_step, end_step) window
-    (single capture; repeat is accepted for API parity)."""
+    """Reference-style scheduler factory.  The step sequence is
+    `skip_first` steps, then repeating cycles of (closed, ready, record);
+    `repeat=k` records k capture windows (k trace files), `repeat=0` a
+    single window."""
+    cycle = closed + ready + record
     start = skip_first + closed + ready
-    return (start, start + record)
+    n = max(1, repeat)
+    if n > 1 and cycle <= 0:
+        raise ValueError("repeat > 1 needs a positive "
+                         "closed + ready + record cycle")
+    return _Schedule([(start + i * cycle, start + i * cycle + record)
+                      for i in range(n)])
 
 
 class Profiler:
@@ -61,7 +84,15 @@ class Profiler:
                  timer_only=False, log_dir="./profiler_log"):
         self.log_dir = log_dir
         self.timer_only = timer_only
-        self.scheduler = tuple(scheduler) if scheduler is not None else None
+        if scheduler is None:
+            self.scheduler = None
+            self._windows = None
+        else:
+            self.scheduler = tuple(scheduler)
+            self._windows = list(getattr(scheduler, "windows",
+                                         [self.scheduler]))
+        self._windows_captured = 0
+        self._cur_window = None
         self._step_idx = 0
         self._step_times = []
         self._samples = []
@@ -78,20 +109,27 @@ class Profiler:
                 jax.profiler.start_trace(self.log_dir)
                 self._tracing = True
             return
-        lo, hi = self.scheduler
-        # stop-check first so a zero-width window (lo == hi) records nothing
-        if self._tracing and self._step_idx >= hi:
+        # stop-check first so a zero-width window (lo == hi) records
+        # nothing; crossing into a DIFFERENT window closes the previous
+        # capture first, so back-to-back windows still yield one trace each
+        widx = next((i for i, (lo, hi) in enumerate(self._windows)
+                     if lo <= self._step_idx < hi), None)
+        if self._tracing and widx != self._cur_window:
             jax.profiler.stop_trace()
             self._tracing = False
-        if not self._tracing and lo <= self._step_idx < hi:
+        if not self._tracing and widx is not None:
             jax.profiler.start_trace(self.log_dir)
             self._tracing = True
+            self._cur_window = widx
+            self._windows_captured += 1
 
     def start(self):
         self._started = True
         self._step_idx = 0
         self._step_times = []
         self._samples = []
+        self._windows_captured = 0
+        self._cur_window = None
         reset_events()   # each profiling session aggregates its own events
         self._maybe_trace()
         self._t0 = time.perf_counter()
@@ -103,6 +141,11 @@ class Profiler:
         if self._t0 is not None:
             self._step_times.append(t - self._t0)
             self._samples.append(num_samples or 0)
+            if _obs.enabled():
+                _obs.trace.add_complete("profiler_step", "step", self._t0,
+                                        t - self._t0,
+                                        args={"idx": self._step_idx,
+                                              "samples": num_samples or 0})
         self._t0 = t
         self._step_idx += 1
         self._maybe_trace()
@@ -114,8 +157,20 @@ class Profiler:
         self._started = False
 
     # ------------------------------------------------------------- reports
+    _SORT_KEYS = {
+        None: lambda kv: -kv[1][1],          # default: total time
+        "total": lambda kv: -kv[1][1],
+        "count": lambda kv: -kv[1][0],
+        "avg": lambda kv: -(kv[1][1] / kv[1][0]),
+        "max": lambda kv: -kv[1][2],
+    }
+
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
+        if sorted_by not in self._SORT_KEYS:
+            raise ValueError(
+                f"sorted_by={sorted_by!r}: expected one of "
+                f"'count', 'total', 'avg', 'max'")
         lines = []
         if self._step_times:
             times = self._step_times
@@ -131,7 +186,8 @@ class Profiler:
         if op_detail and _event_stats:
             lines.append(f"{'event':<30} {'count':>7} {'total_ms':>10} "
                          f"{'avg_ms':>9} {'max_ms':>9}")
-            items = sorted(_event_stats.items(), key=lambda kv: -kv[1][1])
+            items = sorted(_event_stats.items(),
+                           key=self._SORT_KEYS[sorted_by])
             for name, (n, tot, mx) in items:
                 lines.append(f"{name:<30} {n:>7} {tot*1e3:>10.2f} "
                              f"{tot/n*1e3:>9.2f} {mx*1e3:>9.2f}")

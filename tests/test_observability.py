@@ -1,0 +1,596 @@
+"""Unified telemetry layer: metrics registry round-trips, dispatch
+counters under AMP, recompile-cause diagnosis, collective accounting,
+loader instrumentation, scheduler repeat windows, and Chrome-trace export
+validated by tools/trace_check.py.
+"""
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+
+_TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                      "trace_check.py")
+
+
+def _trace_check():
+    spec = importlib.util.spec_from_file_location("trace_check", _TOOLS)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def telemetry():
+    obs.reset()
+    obs.enable()
+    yield obs
+    obs.disable()
+    obs.reset()
+
+
+# ===================================================================
+# metrics registry
+# ===================================================================
+def test_registry_counter_gauge_histogram():
+    reg = obs.MetricsRegistry()
+    c = reg.counter("reqs_total", route="/a")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    assert reg.counter("reqs_total", route="/a") is c  # get-or-create
+    g = reg.gauge("depth")
+    g.set(7)
+    g.dec(2)
+    assert g.value == 5
+    h = reg.histogram("lat")
+    for v in range(100):
+        h.observe(v / 100.0)
+    assert h.count == 100
+    assert 0.45 <= h.percentile(50) <= 0.55
+    assert h.percentile(99) >= 0.9
+    with pytest.raises(ValueError):
+        reg.gauge("reqs_total", route="/a")  # kind mismatch
+
+
+def test_histogram_nearest_rank_percentile():
+    h = obs.metrics.Histogram()
+    h.observe(1.0)
+    h.observe(2.0)
+    assert h.percentile(50) == 1.0   # median of two is the lower rank
+    assert h.percentile(100) == 2.0
+    h2 = obs.metrics.Histogram()
+    for v in range(1, 101):
+        h2.observe(float(v))
+    assert h2.percentile(50) == 50.0
+    assert h2.percentile(99) == 99.0
+
+
+def test_disable_restores_default_registry():
+    obs.reset()
+    reg = obs.MetricsRegistry()
+    obs.enable(reg)
+    assert obs.registry() is reg
+    pt.matmul(pt.Tensor(np.ones((2, 2), np.float32)),
+              pt.Tensor(np.ones((2, 2), np.float32)))
+    obs.disable()
+    assert obs.registry() is not reg
+    # final totals were materialized into the custom registry on disable
+    names = {r["name"] for r in reg.snapshot()}
+    assert "dispatch_calls_total" in names
+    # a later default-registry session cannot pollute the released one
+    obs.reset()
+    obs.enable()
+    pt.matmul(pt.Tensor(np.ones((2, 2), np.float32)),
+              pt.Tensor(np.ones((2, 2), np.float32)))
+    snap = [r for r in reg.snapshot()
+            if r["name"] == "dispatch_calls_total"]
+    assert all(r["value"] == 1 for r in snap)
+    obs.disable()
+    obs.reset()
+
+
+def test_registry_jsonl_round_trip():
+    reg = obs.MetricsRegistry()
+    reg.counter("a_total", op="matmul").inc(3)
+    reg.gauge("b").set(2.5)
+    reg.histogram("c").observe(1.0)
+    recs = [json.loads(line) for line in reg.to_jsonl().splitlines()]
+    by_name = {(r["name"], tuple(sorted(r["labels"].items()))): r
+               for r in recs}
+    assert by_name[("a_total", (("op", "matmul"),))]["value"] == 3
+    assert by_name[("b", ())]["value"] == 2.5
+    assert by_name[("c", ())]["count"] == 1
+    assert by_name[("c", ())]["p50"] == 1.0
+
+
+def test_registry_prometheus_text():
+    reg = obs.MetricsRegistry()
+    reg.counter("reqs_total", route="/x").inc(2)
+    reg.histogram("lat_seconds").observe(0.5)
+    text = reg.to_prometheus()
+    assert "# TYPE reqs_total counter" in text
+    assert 'reqs_total{route="/x"} 2' in text
+    assert "# TYPE lat_seconds summary" in text
+    assert 'lat_seconds{quantile="0.5"} 0.5' in text
+    assert "lat_seconds_count 1" in text
+
+
+# ===================================================================
+# dispatch-layer tracing
+# ===================================================================
+def test_dispatch_counters_under_amp(telemetry):
+    x = pt.Tensor(np.random.randn(4, 8).astype(np.float32))
+    y = pt.Tensor(np.random.randn(8, 4).astype(np.float32))
+    with pt.amp.auto_cast(level="O1", dtype="bfloat16"):
+        pt.matmul(x, y)
+    stats = obs.dispatch_stats()
+    assert stats["ops"]["matmul"] == 1
+    # O1 + allow-listed matmul: both fp32 operands cast to bf16
+    assert stats["amp_casts"]["matmul"] == 2
+    # counters materialize into the registry at export time
+    snap = {(r["name"], r["labels"].get("op")): r
+            for r in obs.registry().snapshot()}
+    assert snap[("dispatch_calls_total", "matmul")]["value"] == 1
+    assert snap[("amp_casts_total", "matmul")]["value"] == 2
+
+
+def test_dispatch_no_casts_outside_amp(telemetry):
+    x = pt.Tensor(np.random.randn(4, 8).astype(np.float32))
+    y = pt.Tensor(np.random.randn(8, 4).astype(np.float32))
+    pt.matmul(x, y)
+    assert obs.dispatch_stats()["amp_casts"] == {}
+
+
+def test_pallas_override_hit_counter(telemetry):
+    from paddle_tpu.ops import dispatch
+    name = "_obs_test_op"
+    dispatch.register(name, lambda x: x + 1)
+    try:
+        t = pt.Tensor(np.zeros((2,), np.float32))
+        dispatch.call(name, t)
+        assert obs.dispatch_stats()["pallas_hits"].get(name) is None
+        dispatch.override(name, lambda x: x + 2)
+        dispatch.call(name, t)
+        assert obs.dispatch_stats()["pallas_hits"][name] == 1
+    finally:
+        dispatch._REGISTRY.pop(name, None)
+        dispatch._OVERRIDDEN.discard(name)
+
+
+def test_override_restore_clears_pallas_hit(telemetry):
+    from paddle_tpu.ops import dispatch
+    name = "_obs_restore_op"
+    dispatch.register(name, lambda x: x + 1)
+    try:
+        t = pt.Tensor(np.zeros((2,), np.float32))
+        old = dispatch.override(name, lambda x: x + 2)
+        dispatch.call(name, t)
+        dispatch.override(name, old)   # restore the register()-time impl
+        dispatch.call(name, t)
+        assert obs.dispatch_stats()["pallas_hits"][name] == 1  # not 2
+    finally:
+        dispatch._REGISTRY.pop(name, None)
+        dispatch._OVERRIDDEN.discard(name)
+
+
+def test_mesh_gauges_survive_enable_order(telemetry):
+    from paddle_tpu.distributed import fleet
+    fleet.init()   # before OR after enable(): collector reads live mesh
+    snap = {(r["name"], r["labels"].get("axis")): r["value"]
+            for r in obs.registry().snapshot()}
+    assert snap[("mesh_axis_degree", "dp")] >= 1
+
+
+def test_dispatch_disabled_counts_nothing():
+    obs.reset()
+    obs.disable()
+    from paddle_tpu.ops import dispatch
+    assert dispatch._TELEMETRY is None
+    pt.matmul(pt.Tensor(np.ones((2, 2), np.float32)),
+              pt.Tensor(np.ones((2, 2), np.float32)))
+    assert obs.dispatch_stats()["ops"] == {}
+
+
+# ===================================================================
+# compile tracking / recompile detector
+# ===================================================================
+def test_recompile_detector_shape_and_dtype(telemetry):
+    import paddle_tpu.jit as jit
+
+    @jit.to_static
+    def f(a):
+        return a * 2 + 1
+
+    f(pt.Tensor(np.ones((4,), np.float32)))
+    f(pt.Tensor(np.ones((4,), np.float32)))   # cache hit: no new event
+    f(pt.Tensor(np.ones((8,), np.float32)))
+    f(pt.Tensor(np.ones((8,), np.int32)))
+    causes = [e.cause for e in obs.compile_tracker.events()
+              if e.label.startswith("to_static_fn(")]
+    assert causes == ["first compile", "shape change", "dtype change"]
+    assert all(e.wall_s >= 0 for e in obs.compile_tracker.events())
+
+
+def test_recompile_detector_static_arg(telemetry):
+    import paddle_tpu.jit as jit
+
+    @jit.to_static
+    def g(a, flag):
+        return a + 1 if flag else a - 1
+
+    x = pt.Tensor(np.ones((3,), np.float32))
+    g(x, True)
+    g(x, False)
+    causes = [e.cause for e in obs.compile_tracker.events()]
+    assert causes == ["first compile", "new static arg"]
+
+
+def test_recompile_warning_fires(telemetry):
+    import paddle_tpu.jit as jit
+    obs.compile_tracker.set_warn_after(1)
+    try:
+        @jit.to_static
+        def h(a):
+            return a * 3
+
+        h(pt.Tensor(np.ones((2,), np.float32)))
+        with pytest.warns(obs.RecompileWarning, match="shape"):
+            h(pt.Tensor(np.ones((5,), np.float32)))
+    finally:
+        obs.compile_tracker.set_warn_after(5)
+
+
+def test_enable_retargets_registry_for_all_instruments():
+    import paddle_tpu.jit as jit
+    obs.reset()
+    reg = obs.MetricsRegistry()
+    obs.enable(reg)
+    try:
+        @jit.to_static
+        def f(a):
+            return a + 1
+
+        f(pt.Tensor(np.ones((2,), np.float32)))
+        names = {r["name"] for r in reg.snapshot()}
+        assert "jit_compiles_total" in names       # compile tracker
+        assert "dispatch_calls_total" in names     # dispatch collector
+    finally:
+        obs.disable()
+        obs.metrics.set_registry(None)
+        obs.reset()
+
+
+def test_detector_tracks_instances_separately(telemetry):
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import train_step
+
+    def make_step():
+        net = nn.Linear(3, 1)
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+        return train_step(net, lambda m, x, y: ((m(x) - y) ** 2).mean(),
+                          opt)
+
+    x = pt.Tensor(np.random.randn(4, 3).astype(np.float32))
+    y = pt.Tensor(np.random.randn(4, 1).astype(np.float32))
+    s1, s2 = make_step(), make_step()
+    s1(x, y)
+    s2(x, y)   # same label, same shapes, NEW jit cache
+    evs = [e for e in obs.compile_tracker.events()
+           if e.label == "TrainStep(Linear)"]
+    assert [e.cause for e in evs] == ["first compile", "first compile"]
+    assert obs.compile_tracker.compile_count("TrainStep(Linear)") == 2
+
+
+def test_detector_prunes_on_owner_gc(telemetry):
+    import gc
+    from paddle_tpu.observability import compile_tracker as ct
+
+    class Owner:
+        pass
+
+    owner = Owner()
+    sig = ct.signature_of([np.ones((2,), np.float32)])
+    tok = ct.on_call("prune_me", sig, owner=owner)
+    ct.finish(tok)
+    assert ct.compile_count("prune_me") == 1
+    del owner
+    gc.collect()
+    # the dead owner's entry is dropped, so a recycled id can never
+    # suppress a fresh instance's first compile
+    assert ct.compile_count("prune_me") == 0
+
+
+def test_metrics_logger_cleans_up_on_crash(tmp_path):
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.hapi.callbacks import Callback, MetricsLogger
+    obs.reset()
+
+    class Boom(RuntimeError):
+        pass
+
+    class Exploder(Callback):
+        def on_train_batch_end(self, step, logs=None):
+            if step == 1:
+                raise Boom()
+
+    net = nn.Linear(2, 1)
+    model = Model(net)
+    model.prepare(
+        pt.optimizer.SGD(learning_rate=0.1, parameters=net.parameters()),
+        loss=lambda pred, label: ((pred - label) ** 2).mean())
+    data = [(np.ones(2, np.float32), np.ones(1, np.float32))] * 8
+    trace_path = str(tmp_path / "crash_trace.json")
+    with pytest.raises(Boom):
+        model.fit(data, batch_size=2, epochs=1, verbose=0,
+                  callbacks=[MetricsLogger(trace_path=trace_path),
+                             Exploder()])
+    # telemetry released and the partial trace exported for diagnosis
+    assert not obs.enabled()
+    assert _trace_check().check_file(trace_path) == []
+    obs.reset()
+
+
+def test_detector_abort_on_failed_call(telemetry):
+    import paddle_tpu.jit as jit
+
+    @jit.to_static
+    def bad(a, b):
+        return pt.matmul(a, b)
+
+    with pytest.raises(Exception):
+        bad(pt.Tensor(np.ones((2, 3), np.float32)),
+            pt.Tensor(np.ones((4, 5), np.float32)))   # shape mismatch
+    # the failed compile neither recorded an event nor poisoned the cache
+    assert obs.compile_tracker.events() == []
+    a = pt.Tensor(np.ones((2, 3), np.float32))
+    b = pt.Tensor(np.ones((3, 5), np.float32))
+    bad(a, b)
+    assert [e.cause for e in obs.compile_tracker.events()] == \
+        ["first compile"]
+
+
+def test_train_step_compile_event(telemetry):
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import train_step
+    net = nn.Linear(4, 2)
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    step = train_step(net, lambda m, x, y: ((m(x) - y) ** 2).mean(), opt)
+    x = pt.Tensor(np.random.randn(8, 4).astype(np.float32))
+    y = pt.Tensor(np.random.randn(8, 2).astype(np.float32))
+    step(x, y)
+    step(x, y)
+    evs = [e for e in obs.compile_tracker.events()
+           if e.label.startswith("TrainStep(")]
+    assert len(evs) == 1 and evs[0].cause == "first compile"
+
+
+# ===================================================================
+# collective accounting
+# ===================================================================
+def test_collective_accounting(telemetry):
+    from paddle_tpu import distributed as dist
+    t = pt.Tensor(np.ones((4, 8), np.float32))
+    dist.all_reduce(t)
+    out = []
+    dist.all_gather(out, pt.Tensor(np.ones((2, 2), np.float32)))
+    snap = {(r["name"], r["labels"].get("op")): r
+            for r in obs.registry().snapshot()}
+    ar = snap[("comms_bytes_total", "all_reduce")]
+    assert ar["value"] == 4 * 8 * 4 and ar["labels"]["axis"] == "dp"
+    assert snap[("comms_calls_total", "all_reduce")]["value"] == 1
+    assert snap[("comms_bytes_total", "all_gather")]["value"] == 2 * 2 * 4
+    # comms spans land in the trace buffer
+    cats = {e["cat"] for e in obs.trace.events()}
+    assert "comms" in cats
+
+
+# ===================================================================
+# profiler satellites
+# ===================================================================
+def test_make_scheduler_repeat_windows(monkeypatch):
+    from paddle_tpu import profiler as prof
+    calls = {"start": 0, "stop": 0}
+    monkeypatch.setattr(prof.jax.profiler, "start_trace",
+                        lambda *a, **k: calls.__setitem__(
+                            "start", calls["start"] + 1))
+    monkeypatch.setattr(prof.jax.profiler, "stop_trace",
+                        lambda: calls.__setitem__("stop", calls["stop"] + 1))
+    sched = prof.make_scheduler(closed=1, record=2, repeat=3, skip_first=1)
+    assert tuple(sched) == (2, 4)          # legacy first-window view
+    assert sched.windows == [(2, 4), (5, 7), (8, 10)]
+    p = prof.Profiler(scheduler=sched)
+    p.start()
+    for _ in range(12):
+        p.step()
+    p.stop()
+    assert calls["start"] == 3 and calls["stop"] == 3
+    assert p._windows_captured == 3
+
+
+def test_make_scheduler_single_window_back_compat(monkeypatch):
+    from paddle_tpu import profiler as prof
+    sched = prof.make_scheduler(skip_first=1, record=2)
+    assert tuple(sched) == (1, 3)
+    assert sched.windows == [(1, 3)]
+
+
+def test_profiler_summary_sorted_by():
+    from paddle_tpu import profiler as prof
+    prof.reset_events()
+    # many fast "a" events, one slow "b" event
+    prof._event_stats["a"] = [10, 0.010, 0.002]
+    prof._event_stats["b"] = [1, 0.100, 0.100]
+    by_total = prof.Profiler(timer_only=True).summary(sorted_by="total")
+    by_count = prof.Profiler(timer_only=True).summary(sorted_by="count")
+    lines_t = [ln for ln in by_total.splitlines() if ln[:1] in "ab"]
+    lines_c = [ln for ln in by_count.splitlines() if ln[:1] in "ab"]
+    assert lines_t[0].startswith("b") and lines_c[0].startswith("a")
+    avg = prof.Profiler(timer_only=True).summary(sorted_by="avg")
+    mx = prof.Profiler(timer_only=True).summary(sorted_by="max")
+    assert [ln for ln in avg.splitlines() if ln[:1] in "ab"][0][0] == "b"
+    assert [ln for ln in mx.splitlines() if ln[:1] in "ab"][0][0] == "b"
+    with pytest.raises(ValueError):
+        prof.Profiler(timer_only=True).summary(sorted_by="bogus")
+    prof.reset_events()
+
+
+# ===================================================================
+# Model.fit + MetricsLogger → Chrome trace (acceptance path)
+# ===================================================================
+def test_metrics_logger_fit_chrome_trace(tmp_path):
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.hapi.callbacks import Callback, MetricsLogger
+    obs.reset()
+    assert not obs.enabled()
+
+    xs = np.random.randn(16, 4).astype(np.float32)
+    ys = np.random.randn(16, 2).astype(np.float32)
+    data = list(zip(xs, ys))
+    net = nn.Linear(4, 2)
+    model = Model(net)
+    model.prepare(
+        pt.optimizer.SGD(learning_rate=0.1, parameters=net.parameters()),
+        loss=lambda pred, label: ((pred - label) ** 2).mean())
+    class EpochMarker(Callback):
+        """RecordEvent spans from inside the run merge into its trace."""
+
+        def on_epoch_end(self, epoch, logs=None):
+            with pt.profiler.RecordEvent("epoch_mark"):
+                pass
+
+    trace_path = str(tmp_path / "fit_trace.json")
+    logger = MetricsLogger(trace_path=trace_path, batch_size=4)
+    obs.enable()
+    history = model.fit(data, batch_size=4, epochs=2, verbose=0,
+                        callbacks=[logger, EpochMarker()])
+    # telemetry was already on, so MetricsLogger must NOT disable it
+    assert obs.enabled()
+    obs.disable()
+    # percentiles + throughput + memory gauge in the epoch logs
+    assert "step_time_p50" in history[0]
+    assert "steps_per_s" in history[0]
+    assert history[0]["samples_per_s"] > 0
+    assert history[0]["live_array_bytes"] > 0
+    # the trace file is schema-valid and holds step+compile+RecordEvent
+    tc = _trace_check()
+    assert tc.check_file(trace_path,
+                         require_cats=("step", "compile", "host")) == []
+    events = json.load(open(trace_path))["traceEvents"]
+    names = {e["name"] for e in events}
+    assert "train_step" in names
+    assert any(n.startswith("compile:TrainStep(") for n in names)
+    assert "epoch_mark" in names         # RecordEvent span merged in
+    # registry saw the steps: 2 epochs x 4 batches
+    reg = obs.registry()
+    assert reg.counter("fit_steps_total").value == 8
+    assert reg.histogram("fit_step_seconds").count == 8
+    obs.reset()
+
+
+def test_metrics_logger_owns_telemetry_when_off():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.hapi.callbacks import MetricsLogger
+    obs.reset()
+    assert not obs.enabled()
+    net = nn.Linear(2, 1)
+    model = Model(net)
+    model.prepare(
+        pt.optimizer.SGD(learning_rate=0.1, parameters=net.parameters()),
+        loss=lambda pred, label: ((pred - label) ** 2).mean())
+    data = [(np.ones(2, np.float32), np.ones(1, np.float32))] * 4
+    model.fit(data, batch_size=2, epochs=1, verbose=0,
+              callbacks=[MetricsLogger()])
+    assert not obs.enabled()   # enabled for the fit, released after
+    assert obs.registry().counter("fit_steps_total").value == 2
+    obs.reset()
+
+
+def test_trace_check_cli_and_rejects_invalid(tmp_path):
+    tc = _trace_check()
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"traceEvents": [
+        {"name": "x", "ph": "X", "ts": -5, "dur": 1},     # bad ts
+        {"name": "y", "ph": "??", "ts": 0},               # bad phase
+        {"ph": "X", "ts": 0, "dur": -1},                  # no name, bad dur
+        {"name": "z", "ph": "X", "ts": 0, "dur": 2, "pid": "p"},
+    ]}))
+    errs = tc.check_file(str(bad))
+    assert len(errs) >= 4
+    assert tc.main(["trace_check", str(bad)]) == 1
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({"traceEvents": [
+        {"name": "a", "ph": "X", "ts": 0.0, "dur": 1.0, "pid": 1,
+         "tid": 1, "cat": "step"}]}))
+    assert tc.check_file(str(good)) == []
+    assert tc.main(["trace_check", str(good)]) == 0
+    assert tc.main(["trace_check", str(good),
+                    "--require-cats=step"]) == 0
+    assert tc.main(["trace_check", str(good),
+                    "--require-cats=compile"]) == 1
+    # space-separated form from the usage line works too
+    assert tc.main(["trace_check", str(good),
+                    "--require-cats", "step"]) == 0
+
+
+def test_second_fit_trace_excludes_first_run(tmp_path):
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.hapi.callbacks import MetricsLogger
+    obs.reset()
+    net = nn.Linear(2, 1)
+    model = Model(net)
+    model.prepare(
+        pt.optimizer.SGD(learning_rate=0.1, parameters=net.parameters()),
+        loss=lambda pred, label: ((pred - label) ** 2).mean())
+    data = [(np.ones(2, np.float32), np.ones(1, np.float32))] * 4
+    p1, p2 = str(tmp_path / "run1.json"), str(tmp_path / "run2.json")
+    model.fit(data, batch_size=2, epochs=1, verbose=0,
+              callbacks=[MetricsLogger(trace_path=p1)])
+    model.fit(data, batch_size=2, epochs=1, verbose=0,
+              callbacks=[MetricsLogger(trace_path=p2)])
+    n1 = sum(1 for e in json.load(open(p1))["traceEvents"]
+             if e["name"] == "train_step")
+    n2 = sum(1 for e in json.load(open(p2))["traceEvents"]
+             if e["name"] == "train_step")
+    assert n1 == 2 and n2 == 2   # run 2 does NOT replay run 1's spans
+    obs.reset()
+
+
+def test_span_contextmanager(telemetry):
+    with obs.span("unit_of_work", cat="host", args={"k": 1}):
+        pass
+    evs = [e for e in obs.trace.events() if e["name"] == "unit_of_work"]
+    assert len(evs) == 1 and evs[0]["ph"] == "X" and evs[0]["dur"] >= 0
+
+
+# ===================================================================
+# loader instrumentation
+# ===================================================================
+def test_shm_loader_metrics(telemetry):
+    from paddle_tpu.io import native, DataLoader, Dataset
+    if not native.available():
+        pytest.skip("native ring unavailable")
+
+    class Ds(Dataset):
+        def __len__(self):
+            return 12
+
+        def __getitem__(self, i):
+            return np.full((3,), i, np.float32)
+
+    n = sum(1 for _ in DataLoader(Ds(), batch_size=4, num_workers=2))
+    assert n == 3
+    reg = obs.registry()
+    assert reg.histogram("loader_batch_wait_seconds").count == 3
+    snap = {r["name"] for r in reg.snapshot()}
+    assert "loader_queue_depth" in snap

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Validate a Chrome trace_event JSON file (the paddle_tpu.observability
+Chrome-trace export, or any chrome://tracing-format trace).
+
+Usage: python tools/trace_check.py TRACE.json [--require-cats step,compile]
+
+Exit 0 when the file parses and every event passes the schema checks;
+exit 1 with one error per line otherwise.  Wired into the tier-1 suite by
+tests/test_observability.py.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+# trace_event phases per the Trace Event Format spec
+KNOWN_PHASES = {"B", "E", "X", "i", "I", "M", "C", "b", "n", "e", "s",
+                "t", "f", "P", "N", "O", "D", "p", "R", "(", ")"}
+
+
+def check_events(obj, require_cats=()):
+    """Returns a list of error strings (empty = valid)."""
+    errors = []
+    if isinstance(obj, dict):
+        evs = obj.get("traceEvents")
+        if not isinstance(evs, list):
+            return ["top-level object has no 'traceEvents' array"]
+    elif isinstance(obj, list):
+        evs = obj
+    else:
+        return ["top level must be an object with 'traceEvents' or an "
+                "array of events"]
+    if not evs:
+        errors.append("trace contains no events")
+    cats = set()
+    for i, ev in enumerate(evs):
+        where = f"event {i}"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not a JSON object")
+            continue
+        ph = ev.get("ph")
+        if not isinstance(ph, str) or ph not in KNOWN_PHASES:
+            errors.append(f"{where}: bad or missing ph {ph!r}")
+            continue
+        if not isinstance(ev.get("name"), str):
+            errors.append(f"{where}: missing/non-string name")
+        if ph != "M":   # metadata events carry no timestamp
+            ts = ev.get("ts")
+            if not isinstance(ts, (int, float)) or isinstance(ts, bool) \
+                    or ts < 0:
+                errors.append(f"{where} ({ev.get('name')}): bad ts {ts!r}")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or isinstance(dur, bool) \
+                    or dur < 0:
+                errors.append(f"{where} ({ev.get('name')}): 'X' event "
+                              f"needs dur >= 0, got {dur!r}")
+        for k in ("pid", "tid"):
+            if k in ev and (not isinstance(ev[k], int)
+                            or isinstance(ev[k], bool)):
+                errors.append(f"{where}: {k} must be an integer")
+        if "args" in ev and not isinstance(ev["args"], dict):
+            errors.append(f"{where}: args must be an object")
+        if isinstance(ev.get("cat"), str):
+            cats.add(ev["cat"])
+    for cat in require_cats:
+        if cat not in cats:
+            errors.append(f"required category {cat!r} absent "
+                          f"(present: {sorted(cats)})")
+    return errors
+
+
+def check_file(path, require_cats=()):
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"cannot load {path}: {e}"]
+    return check_events(obj, require_cats=require_cats)
+
+
+def main(argv):
+    args, cats, it = [], (), iter(argv[1:])
+    for a in it:
+        if a.startswith("--require-cats"):
+            # both --require-cats=a,b and --require-cats a,b forms
+            val = a.split("=", 1)[1] if "=" in a else next(it, "")
+            cats = tuple(c for c in val.split(",") if c)
+        elif not a.startswith("--"):
+            args.append(a)
+    if len(args) != 1:
+        print(__doc__)
+        return 2
+    errors = check_file(args[0], require_cats=cats)
+    for e in errors:
+        print(f"trace_check: {e}", file=sys.stderr)
+    if not errors:
+        print(f"trace_check: {args[0]} OK")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
