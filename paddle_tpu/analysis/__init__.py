@@ -1,0 +1,77 @@
+"""paddle_tpu.analysis — "tracelint": static trace-safety analysis.
+
+The static half of the correctness tooling: where
+observability/compile_tracker diagnoses recompile storms at RUNTIME,
+tracelint parses the source of functions headed into the jit/dy2static
+path and reports trace hazards BEFORE the first compile — host syncs,
+trace-time impurity, unconvertible control flow, stale baked constants,
+and shape-specialization recompile hazards — plus an auditor for the
+ops/dispatch kernel registry.
+
+Entry points:
+  * `lint_function(fn)` / `lint_source(src)` / `lint_path(path)`
+  * `audit_registry()` — ops registry + ops/ source consistency
+  * `check_traceable(target)` — warning-emitting hook used by
+    `jit.to_static(..., check=True)` and PADDLE_TPU_TRACELINT=1
+  * CLI: `python tools/tracelint.py [--json|--self] PATH...`
+
+See docs/tracelint.md for the rule catalog and suppression syntax
+(`# tracelint: disable=TL001`).
+"""
+from __future__ import annotations
+
+import warnings
+
+from .core import (Finding, Rule, all_rules, lint_file, lint_function,  # noqa: F401
+                   lint_path, lint_source, register_rule, sort_findings,
+                   SEVERITIES)
+from .rules import STATIC_RULE_FOR_CAUSE  # noqa: F401
+from .registry_audit import audit_registry  # noqa: F401
+
+__all__ = ["Finding", "Rule", "all_rules", "register_rule",
+           "lint_function", "lint_source", "lint_file", "lint_path",
+           "audit_registry", "check_traceable", "TraceLintWarning",
+           "STATIC_RULE_FOR_CAUSE", "SEVERITIES", "sort_findings"]
+
+
+class TraceLintWarning(UserWarning):
+    """A tracelint finding surfaced at to_static decoration time."""
+
+
+def env_enabled():
+    """Single source of truth for the PADDLE_TPU_TRACELINT switch
+    (shared by jit.to_static and jit.train_step.TrainStep)."""
+    import os
+    return os.environ.get("PADDLE_TPU_TRACELINT", "").lower() in \
+        ("1", "true", "on")
+
+
+def static_rule_for_cause(cause):
+    """Static rule id covering a runtime recompile cause, or None —
+    lets RecompileWarning point at the pre-compile diagnostic."""
+    return STATIC_RULE_FOR_CAUSE.get(cause)
+
+
+def check_traceable(target, warn=True, min_severity="info"):
+    """Lint a function (or a Layer's forward) headed into to_static.
+
+    Returns the findings; with `warn=True` each one is also surfaced as
+    a TraceLintWarning.  Never raises, never mutates `target` — tracing
+    semantics are unchanged whether or not the check runs.
+    """
+    fn = target
+    forward = getattr(target, "forward", None)
+    if forward is not None and not isinstance(target, type):
+        fn = forward
+    try:
+        findings = lint_function(fn)
+    except Exception:   # linting must never break decoration
+        return []
+    keep = SEVERITIES[:SEVERITIES.index(min_severity) + 1] \
+        if min_severity in SEVERITIES else SEVERITIES
+    findings = [f for f in findings if f.severity in keep]
+    if warn:
+        for f in findings:
+            warnings.warn(f"tracelint: {f.render()}", TraceLintWarning,
+                          stacklevel=3)
+    return findings

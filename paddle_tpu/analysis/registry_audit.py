@@ -1,0 +1,204 @@
+"""tracelint registry auditor — consistency checks over ops/dispatch.
+
+Two views are audited and cross-checked:
+
+  * the LIVE registry (`ops.dispatch._REGISTRY` after import): every op
+    must carry a valid AMP policy, a callable impl, and — for ops whose
+    impl was swapped by a pallas override — a signature compatible with
+    the `base_fn` it replaced (an override that accepts fewer call
+    shapes than its base turns valid calls into TypeErrors only on the
+    TPU path).
+  * the SOURCE under `ops/` (AST): `register("name", ...)` literals must
+    be unique across files (a duplicate silently wins by import order),
+    literal `amp=` values must be valid, and every `override("name", .)`
+    target must name a registered op.
+
+Findings reuse the tracelint `Finding` shape with REGxxx rule ids:
+
+  REG001  invalid amp policy
+  REG002  duplicate source registration
+  REG003  override target not registered
+  REG004  override signature incompatible with base_fn
+  REG005  bad registry entry (non-callable impl / bad name)
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import os
+
+from .core import Finding, sort_findings
+
+VALID_AMP = ("allow", "deny", "keep")
+
+
+def _finding(rule, message, file="<registry>", line=0, hint="", func=""):
+    sev = "error"
+    return Finding(file, line, 0, rule, sev, message, hint=hint, func=func)
+
+
+# ===================================================================
+# live-registry checks
+# ===================================================================
+def _signature_compatible(base_fn, new_fn):
+    """Every call the base accepts must be accepted by the override:
+    the override's non-defaulted params must all exist in the base, and
+    each base param must be accepted (by name or **kwargs/*args)."""
+    try:
+        b = inspect.signature(base_fn)
+        n = inspect.signature(new_fn)
+    except (TypeError, ValueError):
+        return True, ""   # builtins etc.: nothing to check statically
+    kinds = inspect.Parameter
+    n_names = {p.name for p in n.parameters.values()
+               if p.kind in (kinds.POSITIONAL_ONLY,
+                             kinds.POSITIONAL_OR_KEYWORD,
+                             kinds.KEYWORD_ONLY)}
+    n_has_varkw = any(p.kind == kinds.VAR_KEYWORD
+                      for p in n.parameters.values())
+    n_has_varpos = any(p.kind == kinds.VAR_POSITIONAL
+                       for p in n.parameters.values())
+    for p in b.parameters.values():
+        if p.kind in (kinds.VAR_POSITIONAL, kinds.VAR_KEYWORD):
+            continue
+        if p.name not in n_names and not n_has_varkw and not n_has_varpos:
+            return False, f"base param '{p.name}' not accepted"
+    for p in n.parameters.values():
+        if p.kind in (kinds.VAR_POSITIONAL, kinds.VAR_KEYWORD):
+            continue
+        if p.default is kinds.empty and p.name not in b.parameters:
+            return False, (f"override requires param '{p.name}' the "
+                           f"base never passes")
+    return True, ""
+
+
+def audit_live_registry():
+    from ..ops import dispatch
+    findings = []
+    for name, op in sorted(dispatch._REGISTRY.items()):
+        if not isinstance(name, str) or not name:
+            findings.append(_finding(
+                "REG005", f"registry key {name!r} is not a non-empty "
+                f"string", func=str(name)))
+            continue
+        if not callable(op.fn):
+            findings.append(_finding(
+                "REG005", f"op '{name}' impl is not callable "
+                f"({type(op.fn).__name__})", func=name))
+        if op.amp not in VALID_AMP:
+            findings.append(_finding(
+                "REG001", f"op '{name}' has invalid amp policy "
+                f"{op.amp!r} (must be one of {VALID_AMP})", func=name,
+                hint="register(name, fn, amp='allow'|'deny'|'keep')"))
+        if name in dispatch._OVERRIDDEN:
+            ok, why = _signature_compatible(op.base_fn, op.fn)
+            if not ok:
+                findings.append(_finding(
+                    "REG004", f"override for op '{name}' is not "
+                    f"signature-compatible with its base impl: {why}",
+                    func=name,
+                    hint="match the base kernel's parameters (extra "
+                         "params need defaults)"))
+    return findings
+
+
+# ===================================================================
+# source checks (walk ops/ for register/override literals)
+# ===================================================================
+def _call_name(node):
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _literal_calls(tree):
+    """Yield (kind, name, amp, node) for register()/override() calls and
+    functools.partial(register, "name", ...) decorator forms with a
+    string-literal op name."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _call_name(node)
+        args = node.args
+        if kind == "partial" and args and \
+                isinstance(args[0], ast.Name) and \
+                args[0].id == "register":
+            kind, args = "register", args[1:]
+        if kind not in ("register", "override"):
+            continue
+        if not (args and isinstance(args[0], ast.Constant)
+                and isinstance(args[0].value, str)):
+            continue
+        amp = None
+        for kw in node.keywords:
+            if kw.arg == "amp":
+                amp = kw.value
+        yield kind, args[0].value, amp, node
+
+
+def audit_ops_source(ops_dir=None):
+    if ops_dir is None:
+        ops_dir = os.path.dirname(
+            os.path.abspath(
+                __import__("paddle_tpu.ops", fromlist=["x"]).__file__))
+    findings = []
+    registered: dict = {}    # name -> (file, line)
+    overrides = []           # (name, file, line)
+    for dirpath, dirnames, filenames in os.walk(ops_dir):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                try:
+                    tree = ast.parse(f.read())
+                except SyntaxError as e:
+                    findings.append(_finding(
+                        "REG005", f"cannot parse: {e.msg}", file=path,
+                        line=e.lineno or 0))
+                    continue
+            for kind, name, amp, node in _literal_calls(tree):
+                if kind == "register":
+                    if name in registered:
+                        pf, pl = registered[name]
+                        findings.append(_finding(
+                            "REG002",
+                            f"op '{name}' registered twice (first at "
+                            f"{os.path.basename(pf)}:{pl}); the later "
+                            f"registration silently wins",
+                            file=path, line=node.lineno, func=name))
+                    else:
+                        registered[name] = (path, node.lineno)
+                    if amp is not None and isinstance(amp, ast.Constant) \
+                            and amp.value not in VALID_AMP:
+                        findings.append(_finding(
+                            "REG001",
+                            f"op '{name}' registered with invalid amp "
+                            f"policy {amp.value!r}", file=path,
+                            line=node.lineno, func=name))
+                else:
+                    overrides.append((name, path, node.lineno))
+    live = set()
+    try:
+        from ..ops import dispatch
+        live = set(dispatch._REGISTRY)
+    except Exception:
+        pass
+    for name, path, line in overrides:
+        if name not in registered and name not in live:
+            findings.append(_finding(
+                "REG003", f"override target '{name}' is never "
+                f"registered", file=path, line=line, func=name,
+                hint="register the base op before overriding it"))
+    return findings
+
+
+def audit_registry(ops_dir=None):
+    """Full audit: live registry + ops/ source.  Returns findings
+    (empty = healthy)."""
+    return sort_findings(audit_live_registry() +
+                         audit_ops_source(ops_dir=ops_dir))

@@ -1,0 +1,509 @@
+"""tracelint rule catalog — trace-safety rules for jit/dy2static code.
+
+Severity contract:
+  * error — the trace will break (concretization error) or silently
+    compute the wrong thing (stale baked constants).
+  * warn  — legal but hazardous: recompile storms, baked entropy/time,
+    side effects that happen once at trace time.
+  * info  — harmless at runtime but usually not what the author meant
+    (e.g. `print` fires at trace time only).
+
+Each rule documents its id, a minimal bad example, and the fix; the
+same text is mirrored in docs/tracelint.md.  Suppress a finding with
+`# tracelint: disable=TLxxx` on the offending line.
+
+Cross-reference to the runtime half (observability/compile_tracker):
+`STATIC_RULE_FOR_CAUSE` maps a diagnosed recompile cause to the static
+rule id that catches it before the first compile; RecompileWarning
+messages name it so the runtime and static diagnostics meet.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Rule, register_rule
+from .taint import TENSOR, SHAPE, taint_of
+
+# runtime recompile cause (compile_tracker.diagnose) -> static rule id
+STATIC_RULE_FOR_CAUSE = {
+    "shape change": "TL010",
+    "shape+dtype change": "TL010",
+    "new static arg": "TL009",
+}
+
+_HOST_SYNC_METHODS = ("numpy", "item", "tolist")
+
+# dotted-call prefixes considered wall-clock / entropy sources
+_TIME_CALLS = {"time.time", "time.perf_counter", "time.monotonic",
+               "time.process_time", "time.time_ns", "time.sleep",
+               "datetime.now", "datetime.utcnow",
+               "datetime.datetime.now", "datetime.datetime.utcnow"}
+_RANDOM_PREFIXES = ("random.", "np.random.", "numpy.random.")
+
+_MUTATING_METHODS = {"append", "extend", "insert", "add", "update",
+                     "pop", "popitem", "remove", "discard", "clear",
+                     "setdefault", "sort", "reverse"}
+
+
+def _dotted(node):
+    """a.b.c for an Attribute/Name chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# ===================================================================
+# host synchronization
+# ===================================================================
+@register_rule
+class HostSyncCall(Rule):
+    """TL001 — `.numpy()` / `.item()` / `.tolist()` on a traced tensor.
+
+    bad:  threshold = loss.item()
+    good: keep the value on device (`jnp`-side ops), or compute it
+          outside the jitted function.
+    """
+    id = "TL001"
+    severity = "error"
+    name = "host-sync-call"
+    description = ("host-synchronizing method on a traced tensor "
+                   "(concretization error inside jit)")
+    interests = (ast.Call,)
+
+    def visit(self, node, fctx):
+        f = node.func
+        if isinstance(f, ast.Attribute) and \
+                f.attr in _HOST_SYNC_METHODS and \
+                taint_of(f.value) >= TENSOR:
+            yield fctx.finding(
+                self, node,
+                f"'.{f.attr}()' on a traced tensor forces a host sync; "
+                f"inside a jit trace this raises a concretization error",
+                hint="compute on-device, or move this out of the traced "
+                     "function (jit.not_to_static)")
+
+
+@register_rule
+class HostSyncCast(Rule):
+    """TL002 — `float()` / `int()` / `bool()` over a traced tensor.
+
+    bad:  if bool(mask.sum()): ...
+    good: use tensor ops (`jnp.where`, `lax.cond` via dy2static `if`).
+    """
+    id = "TL002"
+    severity = "error"
+    name = "host-scalar-cast"
+    description = "python scalar cast concretizes a traced tensor"
+    interests = (ast.Call,)
+
+    def visit(self, node, fctx):
+        f = node.func
+        if isinstance(f, ast.Name) and \
+                f.id in ("float", "int", "bool", "complex") and \
+                node.args and taint_of(node.args[0]) >= TENSOR:
+            yield fctx.finding(
+                self, node,
+                f"'{f.id}()' on a traced tensor concretizes it at trace "
+                f"time (errors under jit, bakes a constant otherwise)",
+                hint="keep the value as a 0-d tensor; dy2static converts "
+                     "tensor predicates to lax.cond")
+
+
+# ===================================================================
+# impure calls (trace-time baking)
+# ===================================================================
+@register_rule
+class WallClockCall(Rule):
+    """TL003 — wall-clock reads inside traced code.
+
+    bad:  t0 = time.time()   # runs ONCE, at trace time
+    good: time outside the traced function (the compiled program caches).
+    """
+    id = "TL003"
+    severity = "warn"
+    name = "trace-time-clock"
+    description = "wall-clock call executes once at trace time"
+    interests = (ast.Call,)
+
+    def visit(self, node, fctx):
+        d = _dotted(node.func)
+        if d in _TIME_CALLS:
+            yield fctx.finding(
+                self, node,
+                f"'{d}()' runs once at trace time; every later call of "
+                f"the compiled program reuses that single baked value",
+                hint="measure outside the traced function")
+
+
+@register_rule
+class ImpureRandom(Rule):
+    """TL004 — `random.*` / `np.random.*` inside traced code.
+
+    bad:  noise = np.random.randn(*x.shape)   # same noise every step
+    good: paddle_tpu random ops (rng threaded through the trace).
+    """
+    id = "TL004"
+    severity = "warn"
+    name = "trace-time-random"
+    description = "host RNG is drawn once at trace time (baked constant)"
+    interests = (ast.Call,)
+
+    def visit(self, node, fctx):
+        d = _dotted(node.func)
+        if d and (d.startswith(_RANDOM_PREFIXES)):
+            yield fctx.finding(
+                self, node,
+                f"'{d}()' draws host randomness once at trace time — the "
+                f"compiled program replays the same values every call",
+                hint="use paddle_tpu tensor RNG ops (randn/uniform/"
+                     "dropout), which thread the traced rng key")
+
+
+@register_rule
+class PrintInTrace(Rule):
+    """TL005 — `print` in traced code (fires at trace time only).
+
+    bad:  print("step", loss)
+    good: jax.debug.print, or log outside the traced function.
+    """
+    id = "TL005"
+    severity = "info"
+    name = "trace-time-print"
+    description = "print executes at trace time, not per step"
+    interests = (ast.Call,)
+
+    def visit(self, node, fctx):
+        if isinstance(node.func, ast.Name) and node.func.id == "print":
+            yield fctx.finding(
+                self, node,
+                "print() executes once at trace time (and shows tracers, "
+                "not values); it is silent on later compiled calls",
+                hint="use jax.debug.print for per-step values")
+
+
+# ===================================================================
+# side effects
+# ===================================================================
+@register_rule
+class ClosureSideEffect(Rule):
+    """TL006 — mutating closure/global state from traced code.
+
+    bad:  history.append(loss)        # appends a tracer, once
+    bad:  global step; step += 1
+    good: return the value; keep state in buffers/outputs.
+    """
+    id = "TL006"
+    severity = "warn"
+    name = "closure-side-effect"
+    description = "python side effect on closure/global state in trace"
+    interests = (ast.Global, ast.Nonlocal, ast.Call)
+
+    def visit(self, node, fctx):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+            yield fctx.finding(
+                self, node,
+                f"'{kind} {', '.join(node.names)}': rebinding outer state "
+                f"from traced code happens at trace time only (and blocks "
+                f"dy2static conversion of this function)",
+                hint="thread the value through function outputs instead")
+            return
+        f = node.func
+        if isinstance(f, ast.Attribute) and \
+                f.attr in _MUTATING_METHODS and \
+                isinstance(f.value, ast.Name) and \
+                f.value.id not in fctx.bound_names:
+            yield fctx.finding(
+                self, node,
+                f"'{f.value.id}.{f.attr}(...)' mutates closure/global "
+                f"state from traced code — the mutation happens once at "
+                f"trace time (with tracer values), not per call",
+                hint="return the value from the traced function instead")
+
+
+# ===================================================================
+# dy2static convertibility
+# ===================================================================
+def _all_paths_return(body):
+    if not body:
+        return False
+    last = body[-1]
+    if isinstance(last, ast.Return):
+        return last.value is not None
+    if isinstance(last, ast.If):
+        return _all_paths_return(last.body) and \
+            _all_paths_return(last.orelse)
+    return False
+
+
+class _BlockScan(ast.NodeVisitor):
+    """break/continue bound to this block + effect stores, mirroring
+    dy2static._BlockInfo's convertibility contract."""
+
+    def __init__(self):
+        self.has_return = False
+        self.loopjumps = []       # Break/Continue nodes bound here
+        self.effect_stores = []   # attribute/subscript store targets
+        self._loop_depth = 0
+
+    def scan(self, body):
+        for s in body:
+            self.visit(s)
+        return self
+
+    def visit_Return(self, node):
+        self.has_return = True
+
+    def visit_Break(self, node):
+        if self._loop_depth == 0:
+            self.loopjumps.append(node)
+
+    def visit_Continue(self, node):
+        if self._loop_depth == 0:
+            self.loopjumps.append(node)
+
+    def visit_While(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    def visit_For(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    def visit_FunctionDef(self, node):
+        pass   # nested defs are their own scope
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _store(self, t):
+        if isinstance(t, (ast.Attribute, ast.Subscript)) and \
+                isinstance(t.ctx, (ast.Store, ast.Del)):
+            self.effect_stores.append(t)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._store(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._store(node.target)
+        self.generic_visit(node)
+
+
+@register_rule
+class TensorIfEarlyExit(Rule):
+    """TL007 — early return/break/continue under a tensor predicate.
+
+    dy2static converts tensor `if`s to lax.cond only when control flow
+    is structured: a `return` must appear in the every-path-returns form
+    and `break`/`continue` cannot cross the block (see the
+    jit/dy2static.py docstring contract).  Anything else is left
+    unconverted and the tensor predicate raises at trace time.
+
+    bad:  if x.sum() > 0: return x
+          y = x + 1 ...
+    good: give both paths a return, or compute a mask instead.
+    """
+    id = "TL007"
+    severity = "error"
+    name = "tensor-early-exit"
+    description = ("early return/break/continue under a tensor `if` is "
+                   "unconvertible (dy2static contract)")
+    interests = (ast.If,)
+
+    def visit(self, node, fctx):
+        if taint_of(node.test) < TENSOR:
+            return
+        t = _BlockScan().scan(node.body)
+        f = _BlockScan().scan(node.orelse)
+        for jump in t.loopjumps + f.loopjumps:
+            word = "break" if isinstance(jump, ast.Break) else "continue"
+            yield fctx.finding(
+                self, jump,
+                f"'{word}' under a tensor-valued `if` cannot convert to "
+                f"lax.cond; the predicate will raise a concretization "
+                f"error at trace time",
+                hint="rewrite with a boolean mask or loop-carried flag")
+        if (t.has_return or f.has_return) and not (
+                _all_paths_return(node.body) and
+                _all_paths_return(node.orelse)):
+            yield fctx.finding(
+                self, node,
+                "early `return` under a tensor-valued `if` only converts "
+                "in the every-path-returns form; this shape is left "
+                "unconverted and errors at trace time",
+                hint="make every path of the if/elif/else chain return, "
+                     "or select with jnp.where")
+
+
+@register_rule
+class TensorIfEffectStore(Rule):
+    """TL011 — attribute/subscript store under a tensor predicate.
+
+    bad:  if x.sum() > 0: self.hits[k] = 1
+    good: functional update threaded through outputs/buffers.
+    """
+    id = "TL011"
+    severity = "warn"
+    name = "tensor-if-effect-store"
+    description = ("attribute/subscript store under a tensor `if` blocks "
+                   "dy2static conversion (side effect lax.cond can't "
+                   "capture)")
+    interests = (ast.If,)
+
+    def visit(self, node, fctx):
+        if taint_of(node.test) < TENSOR:
+            return
+        scan = _BlockScan().scan(node.body + node.orelse)
+        for store in scan.effect_stores:
+            yield fctx.finding(
+                self, store,
+                "store into an attribute/subscript inside a tensor-"
+                "predicate `if`: dy2static refuses the block (side "
+                "effects can't cross lax.cond) and the predicate errors "
+                "at trace time",
+                hint="bind a local name in both branches and assign "
+                     "after the if")
+
+
+# ===================================================================
+# staleness / specialization hazards
+# ===================================================================
+@register_rule
+class ClosureTensorConstant(Rule):
+    """TL008 — closure-captured tensor baked into the trace.
+
+    bad:  w = paddle.randn([d, d])
+          @to_static
+          def f(x): return x @ w     # w is baked; updates invisible
+    good: pass tensors as arguments (or keep them as Layer parameters,
+          which the functional bridge threads explicitly).
+    """
+    id = "TL008"
+    severity = "warn"
+    name = "closure-tensor-constant"
+    description = ("tensor captured from closure/module scope is baked "
+                   "as a trace constant (stale-weight hazard)")
+    interests = (ast.Name,)
+
+    def visit(self, node, fctx):
+        if not isinstance(node.ctx, ast.Load):
+            return
+        names = fctx.closure_tensors | fctx.global_tensors
+        if node.id not in names:
+            return
+        # per-name dedup lives on the fctx (rule instances are shared
+        # module singletons — state here would leak across runs/threads)
+        seen = getattr(fctx, "_tl008_seen", None)
+        if seen is None:
+            seen = fctx._tl008_seen = set()
+        if node.id in seen:
+            return
+        seen.add(node.id)
+        origin = "closure" if node.id in fctx.closure_tensors else \
+            "module-global"
+        yield fctx.finding(
+            self, node,
+            f"'{node.id}' is a {origin} tensor: jit bakes its current "
+            f"value into the compiled program — later in-place updates "
+            f"are invisible (stale-constant hazard)",
+            hint="pass it as an argument or register it as a Layer "
+                 "parameter/buffer")
+
+
+@register_rule
+class MutableDefaultArg(Rule):
+    """TL009 — mutable/unhashable default in a to_static signature.
+
+    Static (non-tensor) arguments key the jit cache; unhashable values
+    (lists/dicts/sets) break the cache key or alias across calls.
+
+    bad:  def forward(self, x, scales=[1.0, 2.0]): ...
+    good: scales=(1.0, 2.0)  (tuple), or None + in-body default.
+    """
+    id = "TL009"
+    severity = "warn"
+    name = "mutable-default-arg"
+    description = ("mutable default argument is an unhashable static-"
+                   "argnum hazard for to_static(input_spec=...)")
+    interests = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+    def visit(self, node, fctx):
+        a = node.args
+        for d in list(a.defaults) + [x for x in a.kw_defaults
+                                     if x is not None]:
+            bad = None
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                bad = {ast.List: "list", ast.Dict: "dict",
+                       ast.Set: "set"}[type(d)]
+            elif isinstance(d, ast.Call) and \
+                    isinstance(d.func, ast.Name) and \
+                    d.func.id in ("list", "dict", "set", "bytearray"):
+                bad = d.func.id
+            if bad:
+                yield fctx.finding(
+                    self, d,
+                    f"{bad} default argument: static (non-tensor) args "
+                    f"key the jit compile cache and must be hashable; a "
+                    f"mutable default also aliases across calls",
+                    hint="use a tuple / None-plus-in-body default")
+
+
+@register_rule
+class ShapeDependentBranch(Rule):
+    """TL010 — python branching on tensor *shape* metadata.
+
+    Legal (shapes are static per trace) but each distinct shape
+    specializes a new compiled program — the recompile storm the
+    runtime compile_tracker diagnoses as cause "shape change".
+
+    bad:  if x.shape[0] > 128: ...
+    good: pad/bucket inputs to stable shapes; branch outside jit.
+    """
+    id = "TL010"
+    severity = "warn"
+    name = "shape-dependent-branch"
+    description = ("branching on tensor shape metadata specializes the "
+                   "trace per shape (recompile hazard; runtime cause "
+                   "'shape change')")
+    interests = (ast.If, ast.While)
+
+    def visit(self, node, fctx):
+        if taint_of(node.test) == SHAPE:
+            kind = "if" if isinstance(node, ast.If) else "while"
+            yield fctx.finding(
+                self, node.test,
+                f"`{kind}` on shape-derived value: compiles one program "
+                f"per distinct input shape (runtime RecompileWarning "
+                f"cause 'shape change' maps to this rule)",
+                hint="pad/bucket batch shapes, or hoist the branch out "
+                     "of the traced function")
+
+
+@register_rule
+class AssertOnTensor(Rule):
+    """TL012 — `assert` over a traced tensor.
+
+    bad:  assert (x > 0).all()
+    good: validate outside the trace, or use checkify-style ops.
+    """
+    id = "TL012"
+    severity = "warn"
+    name = "tensor-assert"
+    description = "assert concretizes a traced tensor at trace time"
+    interests = (ast.Assert,)
+
+    def visit(self, node, fctx):
+        if taint_of(node.test) >= TENSOR:
+            yield fctx.finding(
+                self, node,
+                "assert on a traced tensor concretizes it (errors under "
+                "jit; outside jit it checks once at trace time only)",
+                hint="validate inputs before the traced call")

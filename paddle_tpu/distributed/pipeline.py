@@ -19,6 +19,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..framework.compat import axis_index as _axis_index
+from ..framework.compat import shard_map as _shard_map
+
 
 def _psum(x, axis_name):
     """psum with a CPU-backend workaround: XLA CPU's AllReducePromotion
@@ -43,7 +46,7 @@ def gpipe_spmd(stage_fn, n_stages, n_microbatches, axis_name="pp"):
     def pipelined(stage_params, x_mb):
         # under shard_map: stage_params leading axis == 1 (this stage) — squeeze
         my_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
-        idx = lax.axis_index(axis_name)
+        idx = _axis_index(axis_name)
         P_ = n_stages
         M = n_microbatches
         T = M + P_ - 1
@@ -91,7 +94,7 @@ def pipeline_apply(stage_fn, stacked_params, x_microbatched, mesh,
             lambda _: P(axis_name), stacked_params)
     in_specs = (param_specs, P())     # params sharded by stage; data replicated
     out_specs = P(axis_name)
-    mapped = jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+    mapped = _shard_map(fn, mesh=mesh, in_specs=in_specs,
                            out_specs=out_specs, check_vma=False)
     out = mapped(stacked_params, x_microbatched)
     # out: [n_stages, M, ...] with every stage holding the same emitted
@@ -112,7 +115,7 @@ def gpipe_hybrid(block_apply, n_stages, n_microbatches, axis_name="pp",
     zero for dense blocks) accumulated over every ACTIVE schedule step so
     router losses escape the pipelined scan.
     Returns pipelined(stacked_params, x_mb, key) -> (out, aux_total) for
-    use under ``jax.shard_map(..., axis_names={axis_name})`` where stacked
+    use under ``_shard_map(..., axis_names={axis_name})`` where stacked
     leaves are [n_stages, layers_per_stage, ...] (leading axis sharded
     over pp) and x_mb is [M, mb, ...].
 
@@ -123,7 +126,7 @@ def gpipe_hybrid(block_apply, n_stages, n_microbatches, axis_name="pp",
     def pipelined(stacked_params, x_mb, key):
         # under shard_map the pp axis is manual: leading dim == 1 here
         my_params, my_bufs = _device_tree(stacked_params, mutable_bufs)
-        idx = lax.axis_index(axis_name)
+        idx = _axis_index(axis_name)
         P_, M = n_stages, n_microbatches
         T = M + P_ - 1
         mb_shape = x_mb.shape[1:]
@@ -228,7 +231,7 @@ def interleaved_hybrid(block_apply, n_stages, n_microbatches, n_chunks,
                 f"per-device layer rows ({n_rows}) not divisible by "
                 f"n_chunks ({V})")
         lpc = n_rows // V
-        idx = lax.axis_index(axis_name)
+        idx = _axis_index(axis_name)
         key = jax.random.fold_in(key, idx)
         mb_shape = x_mb.shape[1:]
 
@@ -396,7 +399,7 @@ def onef1b_pipeline(block_apply, mesh, n_stages, n_microbatches,
 
     def fwd_device(stacked_params, x_mb, key):
         my_params, my_bufs = _device_tree(stacked_params, mutable_bufs)
-        idx = lax.axis_index(axis_name)
+        idx = _axis_index(axis_name)
         key_d = jax.random.fold_in(key, idx)
         mb_shape = x_mb.shape[1:]
         T = M + P_ - 1
@@ -446,7 +449,7 @@ def onef1b_pipeline(block_apply, mesh, n_stages, n_microbatches,
     def bwd_device(stacked_params, in_store, key, dy, daux):
         my_params, my_bufs = _device_tree(stacked_params, mutable_bufs)
         in_store = in_store[0]
-        idx = lax.axis_index(axis_name)
+        idx = _axis_index(axis_name)
         key_d = jax.random.fold_in(key, idx)
         mb_shape = dy.shape[1:]
         skew = P_ - 1 - idx     # bwd(m) runs on this device at step skew+m
@@ -556,7 +559,7 @@ def onef1b_interleaved(block_apply, mesh, n_stages, n_microbatches,
                 f"per-device layer rows ({n_rows}) not divisible by "
                 f"n_chunks ({V})")
         lpc = n_rows // V
-        idx = lax.axis_index(axis_name)
+        idx = _axis_index(axis_name)
         key_d = jax.random.fold_in(key, idx)
         mb_shape = x_mb.shape[1:]
 
@@ -621,7 +624,7 @@ def onef1b_interleaved(block_apply, mesh, n_stages, n_microbatches,
         n_rows = jax.tree_util.tree_leaves(my_params)[0].shape[0]
         lpc = n_rows // V
         in_store = in_store[0]
-        idx = lax.axis_index(axis_name)
+        idx = _axis_index(axis_name)
         key_d = jax.random.fold_in(key, idx)
         mb_shape = dy.shape[1:]
         skew = P_ - 1 - idx
@@ -713,12 +716,37 @@ def _two_scan_make(fwd_device, bwd_device, mesh, axis_name, mutable_bufs):
         if mutable_bufs and isinstance(stacked_params, dict):
             buf_specs = {n: P(axis_name) for n in stacked_params
                          if n.startswith("buf::")}
-        fwd_mapped = jax.shard_map(
-            fwd_device, mesh=mesh, in_specs=(pspecs, P(), P()),
+
+        # in_store crosses the map boundary with spec P(axis_name),
+        # which rejects rank-0 leaves (a scalar saved by one stage —
+        # e.g. a MoE router accumulator — cannot be concatenated over
+        # pp).  Flatten it and give scalars a singleton axis on the way
+        # out; the bwd wrapper strips it, with the structure/flags
+        # recorded at fwd trace time (apply_fwd always traces first).
+        store_rec = {}
+
+        def fwd_boxed(stacked, x_mb, key):
+            out, aux, in_store, new_bufs = fwd_device(stacked, x_mb, key)
+            leaves, td = jax.tree_util.tree_flatten(in_store)
+            flags = tuple(getattr(l, "ndim", 1) == 0 for l in leaves)
+            store_rec["td"], store_rec["flags"] = td, flags
+            boxed = tuple(l[None] if f else l
+                          for l, f in zip(leaves, flags))
+            return out, aux, boxed, new_bufs
+
+        def bwd_boxed(stacked, boxed, key, dy, daux):
+            leaves = [l[0] if f else l
+                      for l, f in zip(boxed, store_rec["flags"])]
+            in_store = jax.tree_util.tree_unflatten(store_rec["td"],
+                                                    leaves)
+            return bwd_device(stacked, in_store, key, dy, daux)
+
+        fwd_mapped = _shard_map(
+            fwd_boxed, mesh=mesh, in_specs=(pspecs, P(), P()),
             out_specs=(P(axis_name), P(), P(axis_name), buf_specs),
             axis_names={axis_name}, check_vma=False)
-        bwd_mapped = jax.shard_map(
-            bwd_device, mesh=mesh,
+        bwd_mapped = _shard_map(
+            bwd_boxed, mesh=mesh,
             in_specs=(pspecs, P(axis_name), P(), P(), P()),
             out_specs=(pspecs, P()),
             axis_names={axis_name}, check_vma=False)
@@ -792,7 +820,7 @@ def pipeline_apply_hybrid(block_apply, stacked_params, x_mb, key, mesh,
     if mutable_bufs:
         out_specs = out_specs + ({n: P(axis_name) for n in stacked_params
                                   if n.startswith("buf::")},)
-    mapped = jax.shard_map(fn, mesh=mesh,
+    mapped = _shard_map(fn, mesh=mesh,
                            in_specs=(param_specs, P(), P()),
                            out_specs=out_specs,
                            axis_names={axis_name}, check_vma=False)

@@ -17,6 +17,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..framework.compat import axis_size as _axis_size
+from ..framework.compat import shard_map as _shard_map
+
 
 def _block_attn(q, k, v, scale, qpos, kpos, causal):
     """One KV-block contribution. q:[B,Lq,H,D] k,v:[B,Lk,Hkv,D] with
@@ -67,7 +70,7 @@ def _merge(o1, m1, l1, o2, m2, l2):
 
 def ring_attention_local(q, k, v, axis_name, scale=None, causal=True):
     """Per-device body: call under shard_map with q,k,v sharded on seq dim."""
-    nsh = lax.axis_size(axis_name)
+    nsh = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
@@ -135,8 +138,11 @@ def make_ring_flash_local(axis_name, causal, scale, interpret=False):
         return jnp.where(src == idx, 0, jnp.where(src < idx, 1, 2))
 
     def _fwd_ring(q, k, v):
-        nsh = lax.axis_size(axis_name)
-        idx = lax.axis_index(axis_name)
+        nsh = _axis_size(axis_name)
+        # only the causal mask consumes the device index; an UNUSED
+        # axis_index survives DCE under custom_vjp+shard_map on jax
+        # 0.4.x and lowers to a PartitionId op SPMD rejects
+        idx = lax.axis_index(axis_name) if causal else None
         B, Lq, H, D = q.shape
         o0 = jnp.zeros((B, Lq, H, D), jnp.float32)
         lse0 = jnp.full((B, H, Lq), -jnp.inf, jnp.float32)
@@ -144,8 +150,8 @@ def make_ring_flash_local(axis_name, causal, scale, interpret=False):
 
         def step(carry, s):
             o, lse, kc, vc = carry
-            src = (idx - s) % nsh
             if causal:
+                src = (idx - s) % nsh
                 ob, lseb = lax.switch(
                     _branch_idx(src, idx),
                     [lambda: flash_block_fwd(q, kc, vc, True, scale,
@@ -167,8 +173,8 @@ def make_ring_flash_local(axis_name, causal, scale, interpret=False):
         return o.astype(q.dtype), lse
 
     def _bwd_ring(q, k, v, o, lse, do):
-        nsh = lax.axis_size(axis_name)
-        idx = lax.axis_index(axis_name)
+        nsh = _axis_size(axis_name)
+        idx = lax.axis_index(axis_name) if causal else None   # see _fwd_ring
         perm = [(i, (i + 1) % nsh) for i in range(nsh)]
         dq0 = jnp.zeros(q.shape, jnp.float32)
         dk0 = jnp.zeros(k.shape, jnp.float32)
@@ -176,8 +182,8 @@ def make_ring_flash_local(axis_name, causal, scale, interpret=False):
 
         def step(carry, s):
             dq, kc, vc, dk, dv = carry
-            src = (idx - s) % nsh
             if causal:
+                src = (idx - s) % nsh
                 dqb, dkb, dvb = lax.switch(
                     _branch_idx(src, idx),
                     [lambda: flash_block_bwd(q, kc, vc, o, lse, do, True,
@@ -247,12 +253,12 @@ def ring_attention(q, k, v, mesh=None, axis_name="mp", causal=True,
             q.shape, k.shape, None, q.dtype, v_shape=v.shape,
             is_causal=False)
     if use_flash:
-        fn = jax.shard_map(
+        fn = _shard_map(
             make_ring_flash_local(axis_name, causal, scale, interpret),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)
         return fn(q, k, v)
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(ring_attention_local, axis_name=axis_name,
                           causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

@@ -1,0 +1,84 @@
+"""jax version compatibility shims.
+
+`shard_map` moved and changed surface across jax versions:
+
+  * old (<= 0.4.x): `jax.experimental.shard_map.shard_map` with
+    `check_rep=` and `auto=` (the set of axes left AUTOMATIC — the
+    complement of the manual set).
+  * new: top-level `jax.shard_map` with `check_vma=` (renamed from
+    check_rep) and `axis_names=` (the set of axes made MANUAL).
+
+Call sites in this repo use the NEW spelling; `compat.shard_map`
+translates to whatever the installed jax provides, so the pipeline and
+ring-attention paths work on both.  Resolution happens once at import.
+"""
+from __future__ import annotations
+
+import inspect
+
+import jax
+from jax import lax
+
+_IMPL = getattr(jax, "shard_map", None)
+if _IMPL is None:
+    from jax.experimental.shard_map import shard_map as _IMPL  # type: ignore
+
+_PARAMS = frozenset(inspect.signature(_IMPL).parameters)
+
+# Partial-manual shard_map (manual over SOME mesh axes, GSPMD over the
+# rest) needs the new-style `axis_names` implementation: on old jax the
+# `auto=` spelling lowers manual-axis collectives (ppermute/psum) into a
+# program the bundled XLA rejects with a fatal CHECK (spmd_partitioner
+# "IsManualSubgroup" mismatch) — a process abort, not an exception.
+HAS_PARTIAL_MANUAL = "axis_names" in _PARAMS
+
+def axis_index(axis_name):
+    """`lax.axis_index` — one indirection point so future jax surface
+    moves (as with shard_map/axis_size) stay contained to this module."""
+    return lax.axis_index(axis_name)
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None,
+              axis_names=None):
+    """`jax.shard_map` with new-style kwargs on any supported jax.
+
+    `axis_names` — mesh axes to run in MANUAL mode (partial-manual
+    shard_map); omitted means all axes manual.  On old jax this is
+    translated to the complementary `auto=` set.
+    `check_vma` — value-and-mesh-agreement check (old name: check_rep).
+    """
+    kw = {"mesh": mesh, "in_specs": in_specs, "out_specs": out_specs}
+    if check_vma is not None:
+        if "check_vma" in _PARAMS:
+            kw["check_vma"] = check_vma
+        elif "check_rep" in _PARAMS:
+            kw["check_rep"] = check_vma
+    if axis_names is not None:
+        manual = set(axis_names)
+        if "axis_names" in _PARAMS:
+            kw["axis_names"] = manual
+        elif "auto" in _PARAMS:
+            auto = frozenset(mesh.axis_names) - manual
+            kw["auto"] = auto
+            wide = sorted(a for a in auto if mesh.shape[a] > 1)
+            if wide:
+                # size-1 auto axes are degenerate (nothing for GSPMD to
+                # shard) and compile fine; >1 is the broken case
+                raise NotImplementedError(
+                    "partial-manual shard_map (manual over "
+                    f"{sorted(manual)}, GSPMD over {wide}) is not "
+                    "supported on this jax version: the old-style "
+                    "`auto=` lowering sends manual-axis collectives "
+                    "into a fatal XLA CHECK (spmd_partitioner "
+                    "IsManualSubgroup).  Upgrade jax, or use the "
+                    "full-manual pipeline (pipeline_apply) / a mesh "
+                    "whose non-pipeline axes have degree 1.")
+    return _IMPL(f, **kw)
+
+
+def axis_size(axis_name):
+    """`lax.axis_size` (newer jax) with a psum(1) fallback — inside a
+    mapped body both resolve to a concrete Python int."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)

@@ -160,14 +160,33 @@ def _set_training(layer, mode):
         l.training = mode
 
 
+def _tracelint_enabled(check):
+    if check is not None:
+        return bool(check)
+    if not os.environ.get("PADDLE_TPU_TRACELINT"):
+        return False   # cheap path: no analysis import per decoration
+    from .. import analysis
+    return analysis.env_enabled()
+
+
 def to_static(function=None, input_spec=None, full_graph=True,
-              while_max_iters=None, **kwargs):
+              while_max_iters=None, check=None, **kwargs):
     """Decorator/wrapper compiling a Layer or function to one XLA program.
 
     `while_max_iters`: bound converted tensor-dependent `while` loops to a
     fixed iteration count (lowered to a masked lax.scan), which makes them
-    reverse-differentiable — unbounded while_loops are forward-only."""
+    reverse-differentiable — unbounded while_loops are forward-only.
+
+    `check=True` (or PADDLE_TPU_TRACELINT=1) runs the tracelint static
+    analyzer over the function/forward at decoration time and surfaces
+    findings as TraceLintWarning — purely diagnostic, traced semantics
+    are unchanged (see docs/tracelint.md)."""
     def wrap(target):
+        if _tracelint_enabled(check):
+            from .. import analysis as _analysis
+            _analysis.check_traceable(
+                type(target).forward if isinstance(target, Layer)
+                else target)
         if isinstance(target, Layer):
             return StaticFunction(target, while_max_iters=while_max_iters)
         if callable(target):

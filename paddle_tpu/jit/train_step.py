@@ -26,6 +26,12 @@ class TrainStep:
     """
 
     def __init__(self, model, loss_fn, optimizer, donate=True):
+        import os
+        if os.environ.get("PADDLE_TPU_TRACELINT"):
+            from .. import analysis as _analysis
+            if _analysis.env_enabled():
+                _analysis.check_traceable(type(model).forward)
+                _analysis.check_traceable(loss_fn)
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer

@@ -101,6 +101,22 @@ def diagnose(prev, new):
     return "recompile (unknown cause)"
 
 
+def _static_rule_hint(cause):
+    """Point the runtime diagnostic at its static tracelint rule, so the
+    two halves of the tooling meet: a recompile storm the tracker
+    diagnoses at runtime is usually catchable pre-compile by
+    `tools/tracelint.py` (docs/tracelint.md)."""
+    try:
+        from ..analysis import static_rule_for_cause
+        rule = static_rule_for_cause(cause)
+    except Exception:  # pragma: no cover - analysis must never break this
+        rule = None
+    if rule is None:
+        return ""
+    return (f" [static analyzer: tracelint rule {rule} flags this "
+            f"pattern pre-compile — run tools/tracelint.py]")
+
+
 class _Token:
     __slots__ = ("label", "cause", "index", "t0", "key", "sig_hash",
                  "prev_last")
@@ -146,7 +162,8 @@ def on_call(label, sig, owner=None):
             f"{label} compiled {index} times (latest cause: {cause}); "
             f"recompilation dominates step time — stabilize input "
             f"shapes/dtypes (pad/bucket batches) or hoist the changing "
-            f"python argument out of the jitted call",
+            f"python argument out of the jitted call"
+            f"{_static_rule_hint(cause)}",
             RecompileWarning, stacklevel=3)
     return _Token(label, cause, index, time.perf_counter(), key, h,
                   prev_last)

@@ -48,7 +48,16 @@ def override(name, fn):
     """Swap an op's implementation (e.g. pallas flash-attention on TPU).
     Restoring the register()-time impl takes the op back OFF the
     override-hit books."""
-    op = _REGISTRY[name]
+    op = _REGISTRY.get(name)
+    if op is None:
+        import difflib
+        close = difflib.get_close_matches(name, _REGISTRY, n=3, cutoff=0.6)
+        hint = f"; did you mean {' / '.join(map(repr, close))}?" if close \
+            else ""
+        raise KeyError(
+            f"cannot override unregistered op {name!r}: overrides swap an "
+            f"existing kernel's impl, so the base op must be registered "
+            f"first ({len(_REGISTRY)} ops registered){hint}")
     old = op.fn
     op.fn = fn
     if fn is op.base_fn:

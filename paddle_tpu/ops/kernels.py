@@ -520,8 +520,6 @@ register("polygamma_op",
 register("sgn", lambda x: jnp.where(
     jnp.abs(x) == 0, jnp.zeros_like(x), x / jnp.abs(x))
     if jnp.iscomplexobj(x) else jnp.sign(x))
-register("stanh", lambda x, scale_a=0.67, scale_b=1.7159:
-         scale_b * jnp.tanh(scale_a * x))
 register("index_sample", lambda x, index: jnp.take_along_axis(
     x, index.astype(jnp.int32), axis=1))
 register("scatter_nd_op", lambda index, updates, shape:

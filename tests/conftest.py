@@ -20,6 +20,28 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: deselected from the tier-1 run (-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "needs_partial_manual: requires jax with native "
+        "partial-manual shard_map (axis_names=); skipped on old jax")
+
+
+def pytest_collection_modifyitems(config, items):
+    from paddle_tpu.framework.compat import HAS_PARTIAL_MANUAL
+    if HAS_PARTIAL_MANUAL:
+        return
+    skip = pytest.mark.skip(
+        reason="partial-manual shard_map (GSPMD dp/mp inside a pp-manual "
+               "region) needs jax with native axis_names= support; this "
+               "jax's auto= lowering hits a fatal XLA CHECK "
+               "(framework/compat.py)")
+    for item in items:
+        if "needs_partial_manual" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu

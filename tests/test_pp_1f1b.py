@@ -16,6 +16,7 @@ from paddle_tpu.distributed import fleet
 from paddle_tpu.distributed import mesh as mesh_mod
 
 
+
 @pytest.fixture
 def restore_mesh():
     prev = dict(mesh_mod._state)
@@ -87,6 +88,7 @@ def test_1f1b_matches_gpipe_moe(restore_mesh):
     _assert_parity(restore_mesh, pp=2, M=2, moe=True)
 
 
+@pytest.mark.needs_partial_manual
 def test_1f1b_matches_gpipe_dp_x_pp(restore_mesh):
     """dp stays a GSPMD annotation inside the partial-manual shard_map in
     both the forward AND the hand-written backward."""

@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""tracelint — static trace-safety analyzer + op-registry auditor.
+
+Usage:
+  python tools/tracelint.py PATH...           lint files/directories
+  python tools/tracelint.py --json PATH...    JSON output
+  python tools/tracelint.py --audit           ops registry audit
+  python tools/tracelint.py --self            audit + self-lint of the
+                                              model zoo vs the baseline
+                                              (wired into tier-1 by
+                                              tests/test_tracelint.py)
+  python tools/tracelint.py --write-baseline  refresh the baseline
+
+Rule catalog + suppression syntax: docs/tracelint.md.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
