@@ -67,6 +67,8 @@ from . import regularizer  # noqa: F401
 from .framework.random import get_rng_state, set_rng_state  # noqa: F401
 from .framework import checkpoint  # noqa: F401
 from .framework.checkpoint import save_state, load_state  # noqa: F401
+from .framework.checkpoint import CheckpointError  # noqa: F401
+from . import resilience  # noqa: F401
 from .jit import save, load  # noqa: F401  (paddle.save/paddle.load)
 
 # static-graph mode (framework/static_graph.py): ops keep executing

@@ -100,6 +100,17 @@ class GradScaler:
             if self._good_steps >= self._incr_every_n_steps:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
+        from .. import observability as _obs
+        if _obs.enabled():
+            reg = _obs.metrics.registry()
+            if self._iter_found_inf:
+                # the scaled-fp16 twin of the resilience guard's skip
+                # counter: both nonfinite paths land in one family
+                reg.counter("guard_nonfinite_steps_total",
+                            source="grad_scaler").inc()
+            # AFTER the branches: the gauge tracks the live scale, not
+            # the pre-decrement value
+            reg.gauge("amp_loss_scale").set(self._scale)
 
     def get_loss_scaling(self):
         return self._scale

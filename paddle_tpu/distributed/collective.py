@@ -51,6 +51,12 @@ def _accounted(payload_arg):
 
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
+            from ..resilience import chaos as _chaos
+            if _chaos._PLAN is not None and \
+                    _chaos.fire("collective.fail_once", tag=fn.__name__):
+                raise RuntimeError(
+                    f"chaos: injected collective failure in "
+                    f"{fn.__name__}")
             tel = _TELEMETRY
             if tel is None:
                 return fn(*args, **kwargs)

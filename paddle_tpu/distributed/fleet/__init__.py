@@ -66,9 +66,9 @@ class _Fleet:
         optimizer._fleet_strategy = strategy or self._strategy
         return optimizer
 
-    def build_train_step(self, model, loss_fn, optimizer):
+    def build_train_step(self, model, loss_fn, optimizer, guard=None):
         return DistributedTrainStep(model, loss_fn, optimizer,
-                                    strategy=self._strategy)
+                                    strategy=self._strategy, guard=guard)
 
     # topology queries (HybridCommunicateGroup surface)
     def worker_num(self):

@@ -92,10 +92,13 @@ class DistributedTrainStep:
     """Fused hybrid-parallel train step over the global mesh."""
 
     def __init__(self, model, loss_fn, optimizer, strategy=None,
-                 batch_axis=0):
+                 batch_axis=0, guard=None):
+        from ..resilience import guard as _guard_mod
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        self._guard = guard if guard is not None \
+            else _guard_mod.env_guard()
         self.strategy = strategy
         self.sharding_stage = 0
         hc = {}
@@ -621,6 +624,10 @@ class DistributedTrainStep:
                                   or {}).get("need_clip", True)
                       for fz, p in zip(fleet_frozen, flat_ps)]
 
+        from ..resilience import guard as _guard_mod
+        guarded = self._guard is not None
+        guard_fused = guarded and self._guard.mode == "fused"
+
         def step_fn(param_tree, buffer_arrays, opt_state, lr, step, rng,
                     batch):
             (loss, new_buffers), grads = jax.value_and_grad(
@@ -631,6 +638,14 @@ class DistributedTrainStep:
             flat_g = [None if fz else g
                       for g, fz in zip(flat_g, fleet_frozen)]
             finite = _dbg.finite_flags(loss, flat_g) if check else None
+
+            ok = _guard_mod.all_finite(loss, flat_g) if guarded else None
+            if guarded and guard_fused:
+                # zero grads + lr: bit-exact param no-op that keeps the
+                # donated update in-place; the reduction is replicated,
+                # so every shard takes the same gate
+                flat_g = _guard_mod.gate_grads(ok, flat_g)
+                lr = _guard_mod.gate_lr(ok, lr)
             if optimizer._grad_clip is not None:
                 flat_g = optimizer._clip_grad_arrays(flat_g,
                                                      need_clip=fleet_clip)
@@ -639,7 +654,14 @@ class DistributedTrainStep:
                 param_names=fleet_names, lr_scales=fleet_scales,
                 wd_overrides=fleet_wds)
             new_params = unflatten(new_flat, param_tree)
-            return loss, new_params, new_buffers, new_opt, finite
+            if guarded and not guard_fused:
+                # exact mode: freeze params + optimizer slots (select)
+                new_params, new_opt = _guard_mod.select_tree(
+                    ok, (new_params, new_opt), (param_tree, opt_state))
+            if guarded:
+                new_buffers = _guard_mod.select_tree(ok, new_buffers,
+                                                     buffer_arrays)
+            return loss, new_params, new_buffers, new_opt, finite, ok
 
         params, p_specs, p_sh, b_sh = self._shardings()
         arrays, flat_specs = self._flat_param_arrays()
@@ -661,7 +683,8 @@ class DistributedTrainStep:
             NamedSharding(mesh, P(*(["dp"] + [None] * (a.ndim - 1))))
             if a.ndim > 0 else repl for a in batch_arrays)
         in_sh = (param_in_sh, b_sh, state_sh, repl, repl, repl, batch_sh)
-        out_sh = (repl, param_in_sh, b_sh, state_sh, repl if check else None)
+        out_sh = (repl, param_in_sh, b_sh, state_sh,
+                  repl if check else None, repl if guarded else None)
         self._jitted = jax.jit(step_fn, in_shardings=in_sh,
                                out_shardings=out_sh,
                                donate_argnums=(0, 2))
@@ -708,7 +731,11 @@ class DistributedTrainStep:
         batch_arrays = tuple(
             b._array if isinstance(b, Tensor) else jnp.asarray(b)
             for b in batch)
+        from ..resilience import chaos as _chaos
         if self._jitted is None:
+            # chaos site: a compile failure must surface once and succeed
+            # on retry (_jitted stays None, the next call rebuilds)
+            _chaos.crash("compile.fail_once")
             self._build(batch_arrays)
         if self.use_pp:
             outer_named, _, leaf_names, _ = self._pp_split()
@@ -718,6 +745,8 @@ class DistributedTrainStep:
         else:
             pn, pa, _, _ = FB.split_state(model)
             param_tree = pa
+        if _chaos._PLAN is not None and _chaos.fire("step.nonfinite"):
+            batch_arrays = _chaos.poison_batch(batch_arrays)
         batch_arrays = self._globalize_batch(batch_arrays)
         bn = [n for n, _ in model.named_buffers()]
         ba = [b._array for _, b in model.named_buffers()]
@@ -735,7 +764,7 @@ class DistributedTrainStep:
                 owner=self)
             t0 = time.perf_counter()
         try:
-            loss, new_params, new_buffers, self._opt_state, finite = \
+            loss, new_params, new_buffers, self._opt_state, finite, ok = \
                 self._jitted(param_tree, ba, self._opt_state, lr, step,
                              rng, batch_arrays)
         except BaseException:
@@ -767,5 +796,9 @@ class DistributedTrainStep:
         buffers = dict(model.named_buffers())
         for n, a in zip(bn, new_buffers):
             buffers[n]._inplace_assign(a)
+        if ok is not None:
+            # after the assignments: a guard rollback restores checkpoint
+            # state through set_state_dict and must not be overwritten
+            self._guard.after_step(ok, self)
         optimizer._step_count = self._step
         return Tensor._from_array(loss)

@@ -39,6 +39,18 @@ def _parse_args(argv):
                    help="per-rank stdout/stderr capture directory")
     p.add_argument("--max_restarts", type=int, default=0,
                    help="elastic: restart failed workers this many times")
+    p.add_argument("--restart_backoff", type=float, default=1.0,
+                   help="base seconds of exponential backoff before a "
+                        "restart (doubles per restart; 0 disables)")
+    p.add_argument("--restart_backoff_max", type=float, default=30.0,
+                   help="backoff ceiling in seconds")
+    p.add_argument("--crash_loop_threshold", type=int, default=3,
+                   help="abort when this many worker failures land "
+                        "within --crash_loop_window seconds (restarting "
+                        "a deterministic failure burns restarts for "
+                        "nothing); 0 disables")
+    p.add_argument("--crash_loop_window", type=float, default=60.0,
+                   help="crash-loop detection window in seconds")
     p.add_argument("--devices", default=None,
                    help="accepted for reference compat (unused on TPU)")
     p.add_argument("script", help="training script")
@@ -47,7 +59,7 @@ def _parse_args(argv):
     return p.parse_args(argv)
 
 
-def _worker_env(args, local_rank):
+def _worker_env(args, local_rank, restarts=0):
     env = dict(os.environ)
     world = args.nnodes * args.nproc_per_node
     rank = args.node_rank * args.nproc_per_node + local_rank
@@ -55,6 +67,10 @@ def _worker_env(args, local_rank):
     env["PT_NUM_PROCESSES"] = str(world)
     env["PT_PROCESS_ID"] = str(rank)
     env["PT_LOCAL_RANK"] = str(local_rank)
+    # restart ordinal: lets the script know it is a recovery attempt
+    # (resilience.manager.restart_count() reads this to e.g. prefer
+    # checkpoint fallback over strict resume)
+    env["PT_RESTART_COUNT"] = str(restarts)
     # reference-compatible aliases user scripts may read
     env["PADDLE_TRAINER_ID"] = str(rank)
     env["PADDLE_TRAINERS_NUM"] = str(world)
@@ -66,6 +82,7 @@ class _Worker:
         self.args = args
         self.local_rank = local_rank
         self.restarts = 0
+        self.restart_at = 0.0   # monotonic deadline of a pending restart
         self.proc = None
         self.log = None
 
@@ -76,11 +93,14 @@ class _Worker:
             os.makedirs(self.args.log_dir, exist_ok=True)
             rank = self.args.node_rank * self.args.nproc_per_node + \
                 self.local_rank
+            if self.log:
+                self.log.close()
             self.log = open(os.path.join(self.args.log_dir,
                                          f"worker.{rank}.log"), "ab")
             stdout = stderr = self.log
         self.proc = subprocess.Popen(
-            cmd, env=_worker_env(self.args, self.local_rank),
+            cmd, env=_worker_env(self.args, self.local_rank,
+                                 restarts=self.restarts),
             stdout=stdout, stderr=stderr)
 
     def poll(self):
@@ -99,25 +119,55 @@ class _Worker:
 
 
 def run(argv=None):
+    from ...resilience.backoff import Backoff, CrashLoopDetector
     args = _parse_args(sys.argv[1:] if argv is None else argv)
     workers = [_Worker(args, lr) for lr in range(args.nproc_per_node)]
+    backoff = Backoff(base=args.restart_backoff,
+                      max_delay=args.restart_backoff_max)
+    # one detector across all local workers: a deterministic failure
+    # takes every rank down in lockstep, and restarting into it again
+    # only burns the restart budget
+    detector = CrashLoopDetector(threshold=args.crash_loop_threshold,
+                                 window=args.crash_loop_window)
     for w in workers:
         w.start()
     try:
         while True:
             running = False
+            now = time.monotonic()
             for w in workers:
+                if w.proc is None:       # restart pending its backoff
+                    running = True
+                    if now >= w.restart_at:
+                        w.start()
+                    continue
                 code = w.poll()
                 if code is None:
                     running = True
                 elif code != 0:
+                    crash_looping = detector.record_failure()
+                    if crash_looping:
+                        print(f"[launch] worker {w.local_rank} exited "
+                              f"{code}: {detector.recent_failures} "
+                              f"failures within "
+                              f"{args.crash_loop_window:.0f}s — crash "
+                              f"loop, aborting instead of restarting",
+                              file=sys.stderr)
+                        for o in workers:
+                            if o is not w:
+                                o.terminate()
+                        return code
                     if w.restarts < args.max_restarts:
                         w.restarts += 1
+                        delay = backoff.delay(w.restarts - 1)
                         print(f"[launch] worker {w.local_rank} exited "
                               f"{code}; restart "
-                              f"{w.restarts}/{args.max_restarts}",
+                              f"{w.restarts}/{args.max_restarts} in "
+                              f"{delay:.1f}s (PT_RESTART_COUNT="
+                              f"{w.restarts})",
                               file=sys.stderr)
-                        w.start()
+                        w.proc = None
+                        w.restart_at = now + delay
                         running = True
                     else:
                         print(f"[launch] worker {w.local_rank} failed "

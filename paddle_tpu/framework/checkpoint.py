@@ -19,11 +19,27 @@ import uuid
 import jax
 import numpy as np
 
+from ..resilience import chaos as _chaos
 from ..tensor import Tensor
 from . import random as _random
 
 _ARRAYS = "arrays"
 _META = "meta.json"
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint directory is missing, partial, or torn.
+
+    `path` names the checkpoint; `missing` says which half failed
+    ("arrays" | "meta" | None for a cross-half inconsistency) — precise
+    enough for resilience.CheckpointManager to catch this and fall back
+    to the previous consistent checkpoint.
+    """
+
+    def __init__(self, msg, path=None, missing=None):
+        super().__init__(msg)
+        self.path = path
+        self.missing = missing
 
 
 def _esc(k):
@@ -112,14 +128,25 @@ def save_state(path, model=None, optimizer=None, scaler=None, step=0,
                                            dtype=np.uint8).copy()
     meta["commit_token"] = token
 
-    ckptr = _checkpointer()
-    ckptr.save(os.path.join(path, _ARRAYS), arrays, force=True)
     # meta.json is the checkpoint's commit marker: stage it now, publish it
     # (atomic rename) only after the orbax array write has committed, so a
     # crash mid-save can never pair new meta with old arrays
     tmp = os.path.join(path, _META + ".tmp")
+    if os.path.exists(tmp):
+        # stale stage from a prior crashed save: it pairs with arrays that
+        # never (or already) published — never with the save starting now
+        os.unlink(tmp)
     with open(tmp, "w") as f:
         json.dump(meta, f)
+    if _chaos.active() is not None:
+        # fault sites: crash with the meta staged but the arrays still
+        # old, or deliver the preemption signal mid-save
+        _chaos.crash("ckpt.crash_after_meta_stage")
+        if _chaos.fire("save.sigterm"):
+            import signal as _signal
+            os.kill(os.getpid(), _signal.SIGTERM)
+    ckptr = _checkpointer()
+    ckptr.save(os.path.join(path, _ARRAYS), arrays, force=True)
     handle = _SaveHandle(ckptr, tmp, os.path.join(path, _META))
     if async_save:
         return handle  # caller should .wait_until_finished()
@@ -135,25 +162,74 @@ class _SaveHandle:
 
     def wait_until_finished(self):
         self._ckptr.wait_until_finished()
+        # fault site: arrays committed, meta not yet published — the torn
+        # state load_state must detect via the orphaned .tmp
+        _chaos.crash("ckpt.crash_after_arrays")
         if os.path.exists(self._tmp_meta):
             os.replace(self._tmp_meta, self._meta)
 
 
+def probe(path):
+    """Light consistency probe (no array reads): meta.json published and
+    parseable, arrays/ directory committed.  Returns the parsed meta
+    dict; raises :class:`CheckpointError` naming the path and the failing
+    half.  Shared by `load_state` and the resilience CheckpointManager so
+    the probe and the loader can never silently diverge."""
+    path = os.path.abspath(path)
+    meta_path = os.path.join(path, _META)
+    orphan_tmp = os.path.exists(meta_path + ".tmp")
+    if not os.path.exists(meta_path):
+        raise CheckpointError(
+            f"checkpoint {path}: meta.json is missing" + (
+                " (an orphaned meta.json.tmp is present — the save "
+                "crashed between the array commit and the meta publish)"
+                if orphan_tmp else " (empty or partial checkpoint)"),
+            path=path, missing="meta")
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (ValueError, OSError) as e:
+        raise CheckpointError(
+            f"checkpoint {path}: meta.json is unreadable ({e})",
+            path=path, missing="meta") from e
+    if not os.path.isdir(os.path.join(path, _ARRAYS)):
+        raise CheckpointError(
+            f"checkpoint {path}: arrays/ is missing (empty or partial "
+            f"checkpoint)", path=path, missing="arrays")
+    return meta
+
+
 def load_state(path, model=None, optimizer=None, scaler=None):
     """Restore state saved by `save_state` in place; returns the meta dict
-    (step, extra, ...)."""
+    (step, extra, ...).
+
+    Raises :class:`CheckpointError` naming the path and the failing half
+    (arrays vs meta) on partial/empty/torn checkpoints, so a manager-level
+    fallback can catch precisely what it can recover from.  Validation
+    happens BEFORE any model/optimizer mutation.
+    """
     path = os.path.abspath(path)
+    meta = probe(path)
+    orphan_tmp = os.path.exists(os.path.join(path, _META) + ".tmp")
+    arrays_path = os.path.join(path, _ARRAYS)
     ckptr = _checkpointer()
-    arrays = ckptr.restore(os.path.join(path, _ARRAYS))
-    with open(os.path.join(path, _META)) as f:
-        meta = json.load(f)
+    try:
+        arrays = ckptr.restore(arrays_path)
+    except Exception as e:
+        raise CheckpointError(
+            f"checkpoint {path}: arrays/ failed to restore "
+            f"({type(e).__name__}: {e})", path=path, missing="arrays") \
+            from e
     want = meta.get("commit_token")
     got = arrays.get("commit_token")
     if want is not None and (
             got is None or bytes(np.asarray(got)).hex() != want):
-        raise RuntimeError(
+        raise CheckpointError(
             f"checkpoint {path} is inconsistent (meta/arrays from "
-            f"different saves — interrupted overwrite?)")
+            f"different saves — interrupted overwrite?)" + (
+                "; an orphaned meta.json.tmp is present from the "
+                "interrupted save" if orphan_tmp else ""),
+            path=path)
     if model is not None and "model" in arrays:
         sd = _merge_state_dict(arrays["model"], meta.get("model"))
         model.set_state_dict(sd)

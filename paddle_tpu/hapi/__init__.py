@@ -16,7 +16,8 @@ from ..metric import Metric
 from ..tensor import Tensor
 from . import callbacks as callbacks_mod
 from .callbacks import (Callback, CallbackList, MetricsLogger,
-                        ProgBarLogger, ModelCheckpoint)
+                        ProgBarLogger, ModelCheckpoint,
+                        ResilienceCallback)
 
 __all__ = ["Model"]
 
@@ -228,6 +229,9 @@ class Model:
                 self.network.train()
                 losses = []
                 for step, batch in enumerate(loader):
+                    if self.stop_training:
+                        break   # preemption/early-stop mid-epoch: drain
+                                # at a batch boundary, not at epoch end
                     batch = batch if isinstance(batch, (list, tuple)) \
                         else [batch]
                     cblist.call("on_train_batch_begin", step, {})
@@ -243,8 +247,8 @@ class Model:
                 epoch_logs = {"loss": float(np.mean([np.asarray(a)
                                                      for a in losses]))
                               if losses else 0.0}
-                if eval_loader is not None and \
-                        (epoch + 1) % eval_freq == 0:
+                if eval_loader is not None and not self.stop_training \
+                        and (epoch + 1) % eval_freq == 0:
                     eval_logs = self.evaluate(eval_loader,
                                               batch_size=batch_size,
                                               verbose=0, callbacks=cbs,
@@ -338,6 +342,12 @@ class Model:
     def save(self, path, training=True):
         if training:
             from ..framework import checkpoint as ckpt
+            ts = self._train_step
+            if ts is not None and hasattr(ts, "sync_optimizer_state"):
+                # the fused step owns the optimizer slots after the first
+                # fit batch; hand them back so the checkpoint keeps the
+                # moments (a resume must not silently reset Adam state)
+                ts.sync_optimizer_state()
             ckpt.save_state(path, model=self.network,
                             optimizer=self._optimizer)
         else:

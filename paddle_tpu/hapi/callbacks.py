@@ -9,7 +9,7 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler", "MetricsLogger"]
+           "LRScheduler", "MetricsLogger", "ResilienceCallback"]
 
 
 class Callback:
@@ -269,6 +269,113 @@ class MetricsLogger(Callback):
     # a crash mid-fit must not leak globally-enabled telemetry or an open
     # device trace; the partial Chrome trace is exported — it is exactly
     # what diagnoses the crash
+    on_train_error = on_train_end
+
+
+class ResilienceCallback(Callback):
+    """Wire the resilience layer into Model.fit.
+
+    - retained, step-numbered checkpoints through a
+      resilience.CheckpointManager (every `save_every_steps` train steps,
+      or every `save_freq` epochs), asynchronously so the save overlaps
+      training;
+    - crash-loop-aware auto-resume: on_train_begin restores the newest
+      consistent checkpoint when one exists (falling back past torn
+      ones), so a relaunched process continues instead of restarting;
+    - arms the nonfinite-step guard on the fit train step (guard
+      rollbacks target this callback's manager);
+    - preemption: SIGTERM flushes pending saves, writes one final
+      checkpoint, and stops fit cleanly at the next batch boundary.
+    """
+
+    def __init__(self, manager=None, checkpoint_dir=None, max_to_keep=3,
+                 save_every_steps=0, save_freq=1, guard=None,
+                 restore_on_start=True, handle_sigterm=True,
+                 async_save=True):
+        from ..resilience.manager import CheckpointManager
+        if manager is None:
+            if checkpoint_dir is None:
+                raise ValueError(
+                    "ResilienceCallback needs manager= or checkpoint_dir=")
+            manager = CheckpointManager(checkpoint_dir,
+                                        max_to_keep=max_to_keep)
+        self.manager = manager
+        self.save_every_steps = int(save_every_steps)
+        self.save_freq = int(save_freq)
+        self.guard = guard
+        self.restore_on_start = restore_on_start
+        self.handle_sigterm = handle_sigterm
+        self.async_save = async_save
+
+    def _train_step_obj(self):
+        return getattr(self.model, "_train_step", None)
+
+    def on_train_begin(self, logs=None):
+        from ..framework.checkpoint import CheckpointError
+        ts = self._train_step_obj()
+        if self.guard is not None and ts is not None:
+            if self.guard.manager is None:
+                self.guard.manager = self.manager
+            if ts._guard is not self.guard:
+                ts._guard = self.guard
+                ts._jitted = None   # rebuild with the guarded program
+        if self.handle_sigterm:
+            self.manager.install_preemption_handler()
+        if self.restore_on_start and ts is not None and \
+                self.manager.latest() is not None:
+            try:
+                meta = self.manager.restore(train_step=ts)
+                print(f"[resilience] resumed from "
+                      f"{meta.get('__path__')} at step "
+                      f"{meta.get('step')}")
+            except CheckpointError as e:
+                import warnings
+                warnings.warn(f"auto-resume skipped: {e}", RuntimeWarning)
+
+    def _maybe_stop_preempted(self):
+        if self.manager.preempted and not self.model.stop_training:
+            self._drain_guard()
+            ts = self._train_step_obj()
+            if self.manager.final_save() is None and ts is not None:
+                # preempted before the first periodic save: final_save
+                # has no cached refs yet, save the live train step
+                self.manager.save(ts._step, train_step=ts)
+            self.model.stop_training = True
+
+    def _drain_guard(self):
+        # deferred verdicts (guard check_every>1) must settle before a
+        # save: a pending rollback would otherwise checkpoint a step
+        # number the rollback is about to rewind
+        if self.guard is not None:
+            self.guard.drain()
+
+    def on_train_batch_end(self, step, logs=None):
+        ts = self._train_step_obj()
+        if ts is None:
+            return
+        if self.save_every_steps and \
+                ts._step % self.save_every_steps == 0:
+            self._drain_guard()
+            self.manager.save(ts._step, train_step=ts,
+                              async_save=self.async_save)
+        self._maybe_stop_preempted()
+
+    def on_epoch_end(self, epoch, logs=None):
+        ts = self._train_step_obj()
+        if ts is None:
+            return
+        self._drain_guard()
+        if not self.save_every_steps and \
+                (epoch + 1) % self.save_freq == 0:
+            self.manager.save(ts._step, train_step=ts,
+                              async_save=self.async_save)
+        self._maybe_stop_preempted()
+
+    def on_train_end(self, logs=None):
+        self._drain_guard()
+        self.manager.flush()
+
+    # crashes must not leave a half-published async save behind
     on_train_error = on_train_end
 
 

@@ -340,13 +340,14 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  timeout=0, worker_init_fn=None, persistent_workers=False,
-                 use_shared_memory=True, ring_bytes=None):
+                 use_shared_memory=True, ring_bytes=None, max_respawns=2):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.use_buffer_reader = use_buffer_reader
         self.prefetch_factor = max(prefetch_factor, 1)
         self.timeout = timeout
+        self.max_respawns = max_respawns
         self.worker_init_fn = worker_init_fn
         self.use_shared_memory = use_shared_memory
         self.ring_bytes = ring_bytes
@@ -459,7 +460,8 @@ class DataLoader:
             worker_init_fn=self.worker_init_fn,
             **({"ring_bytes": self.ring_bytes} if self.ring_bytes
                else {}),
-            timeout_s=self.timeout, spec_blob=spec_blob)
+            timeout_s=self.timeout, spec_blob=spec_blob,
+            max_respawns=self.max_respawns)
         for batch in pool:
             yield _rewrap_numpy(batch)
 

@@ -25,6 +25,7 @@ import tempfile
 import threading
 import time
 import traceback
+import warnings
 
 import numpy as np
 
@@ -140,8 +141,18 @@ def _to_numpy_tree(obj):
 
 
 def _worker_main(ring, worker_id, num_workers, dataset, batch_iter_fn,
-                 collate_fn, init_fn):
+                 collate_fn, init_fn, start_batch=0, chaos_directives=None,
+                 chaos_seed=0):
     """Runs in the worker child: produce this worker's batch slice.
+
+    `start_batch` supports crash recovery: a respawned worker re-drives
+    its (deterministic) batch iterator from the top but only SHIPS
+    batches the parent has not already consumed, so a respawn continues
+    the epoch instead of replaying it.
+
+    `chaos_directives` carries injected faults as positional batch
+    ordinals (resolved by the parent's plan at spawn time — see
+    resilience.chaos.take_loader_directives).
 
     Returns True on clean completion.  On error, ships an E-message and
     closes the ring; if even that fails, the ring is left OPEN and False
@@ -152,12 +163,31 @@ def _worker_main(ring, worker_id, num_workers, dataset, batch_iter_fn,
     global _WORKER_INFO
     _WORKER_INFO = WorkerInfo(worker_id, num_workers, dataset)
     signal.signal(signal.SIGINT, signal.SIG_IGN)  # parent handles ^C
+    cd = chaos_directives or {}
+    corrupt_rng = None
+    if cd.get("corrupt_p") is not None:
+        import random as _random_mod
+        # int mix, not a tuple seed (removed in python 3.11)
+        corrupt_rng = _random_mod.Random(chaos_seed * 1000003 + worker_id)
     try:
         if init_fn is not None:
             init_fn(worker_id)
-        for samples in batch_iter_fn(worker_id, num_workers):
+        for i, samples in enumerate(batch_iter_fn(worker_id, num_workers)):
+            if i < start_batch:
+                continue  # already consumed before our predecessor died
+            ordinal = i + 1   # 1-based position in this worker's slice
+            if cd.get("kill_at") == ordinal:
+                os._exit(2)   # simulated SIGKILL/OOM: no E-message
+            if cd.get("hang_at") == ordinal:
+                while True:   # simulated wedge (parent's timeout fires)
+                    time.sleep(3600)
             batch = _to_numpy_tree(collate_fn(samples))
-            ring.write(b"B" + pickle.dumps(batch, protocol=5))
+            payload = pickle.dumps(batch, protocol=5)
+            if cd.get("corrupt_at") == ordinal or (
+                    corrupt_rng is not None and
+                    corrupt_rng.random() < cd["corrupt_p"]):
+                payload = b"\xde\xad" + payload[::-1]
+            ring.write(b"B" + payload)
         ring.close_producer()
         return True
     except BaseException as e:
@@ -185,7 +215,8 @@ def serialize_spec(num_workers, dataset, batch_iter_fn, collate_fn,
         (num_workers, dataset, batch_iter_fn, collate_fn, worker_init_fn))
 
 
-def _worker_entry(ring_path, ring_size, worker_id, spec_blob):
+def _worker_entry(ring_path, ring_size, worker_id, spec_blob,
+                  start_batch=0, chaos_directives=None, chaos_seed=0):
     """Forkserver child entrypoint (module-level: importable by name).
 
     The child NEVER touches the TPU: force its jax platform to cpu before
@@ -211,7 +242,10 @@ def _worker_entry(ring_path, ring_size, worker_id, spec_blob):
         except OSError:
             pass
         ok = _worker_main(ring, worker_id, num_workers, dataset,
-                          batch_iter_fn, collate_fn, init_fn)
+                          batch_iter_fn, collate_fn, init_fn,
+                          start_batch=start_batch,
+                          chaos_directives=chaos_directives,
+                          chaos_seed=chaos_seed)
         code = 0 if ok else 1
     finally:
         os._exit(code)  # skip atexit/GC teardown races
@@ -277,69 +311,138 @@ def _no_main_reimport():
 
 class ShmWorkerPool:
     """Start N forkserver workers, read their rings round-robin in batch
-    order."""
+    order.
+
+    Resilience: a worker that dies hard (SIGKILL/OOM/segfault) or wedges
+    past `timeout_s` is respawned up to `max_respawns` times per slot
+    with exponential backoff, resuming its batch slice after the batches
+    the parent already consumed; a batch whose payload fails to
+    deserialize is skipped and counted, not fatal.
+    """
 
     _POLL_MS = 100  # bounded ring polls so worker death is noticed
 
     def __init__(self, num_workers, dataset, batch_iter_fn, collate_fn,
                  worker_init_fn=None, ring_bytes=_DEFAULT_RING_BYTES,
-                 timeout_s=0, spec_blob=None):
+                 timeout_s=0, spec_blob=None, max_respawns=2,
+                 respawn_backoff=None):
         if spec_blob is None:
             spec_blob = serialize_spec(num_workers, dataset, batch_iter_fn,
                                        collate_fn, worker_init_fn)
-        ctx = _mp_context()
+        self._spec_blob = spec_blob
+        self._ctx = _mp_context()
+        self._ring_bytes = ring_bytes
         self._timeout_ms = int(timeout_s * 1000) if timeout_s else -1
+        self.max_respawns = int(os.environ.get(
+            "PT_LOADER_MAX_RESPAWNS", str(max_respawns)))
+        if respawn_backoff is None:
+            from ..resilience.backoff import Backoff
+            respawn_backoff = Backoff(base=0.2, max_delay=10.0)
+        self._backoff = respawn_backoff
         self._rings = []
         self._procs = []
+        self._consumed = [0] * num_workers   # batches read per slot
+        self._respawns = [0] * num_workers
         try:
             for _ in range(num_workers):
                 self._rings.append(_Ring(ring_bytes))
             with _no_main_reimport():
                 for w in range(num_workers):
-                    p = ctx.Process(
-                        target=_worker_entry,
-                        args=(self._rings[w].path, self._rings[w].size, w,
-                              spec_blob),
-                        daemon=True)
-                    p.start()
-                    self._procs.append(p)
+                    self._procs.append(self._spawn(w, self._rings[w]))
         except BaseException:
             self.shutdown()
             raise
 
-    def _worker_dead(self, ring):
-        """True if this ring's worker exited without closing the ring
+    def _spawn(self, slot, ring, start_batch=0):
+        # loader faults resolve against the PARENT's plan at spawn time:
+        # its counters survive worker death, so a respawned worker does
+        # not re-suffer the kill its predecessor already executed
+        from ..resilience import chaos as _chaos
+        plan = _chaos.active()
+        directives = _chaos.take_loader_directives(slot) \
+            if plan is not None else None
+        p = self._ctx.Process(
+            target=_worker_entry,
+            args=(ring.path, ring.size, slot, self._spec_blob,
+                  start_batch, directives,
+                  plan.seed if plan is not None else 0),
+            daemon=True)
+        p.start()
+        return p
+
+    def _worker_dead(self, slot):
+        """True if this slot's worker exited without closing the ring
         (SIGKILL/OOM/segfault) — data will never arrive."""
-        return not self._procs[self._rings.index(ring)].is_alive()
+        return not self._procs[slot].is_alive()
+
+    def _respawn(self, slot, reason):
+        """Replace a dead/wedged worker: fresh ring + process resuming
+        after the batches already consumed.  False when the respawn
+        budget for this slot is exhausted."""
+        if self._respawns[slot] >= self.max_respawns:
+            return False
+        attempt = self._respawns[slot]
+        self._respawns[slot] += 1
+        from .. import observability as _obs
+        if _obs.enabled():
+            _obs.metrics.registry().counter(
+                "loader_worker_respawns_total").inc()
+        warnings.warn(
+            f"DataLoader worker {slot} {reason}; respawning "
+            f"({self._respawns[slot]}/{self.max_respawns}, backoff "
+            f"{self._backoff.delay(attempt):.2f}s)", RuntimeWarning)
+        proc = self._procs[slot]
+        if proc.is_alive():
+            proc.terminate()
+        proc.join()
+        self._rings[slot].release()
+        self._backoff.wait(attempt)
+        ring = _Ring(self._ring_bytes)
+        self._rings[slot] = ring
+        with _no_main_reimport():
+            self._procs[slot] = self._spawn(
+                slot, ring, start_batch=self._consumed[slot])
+        return True
 
     def __iter__(self):
         from .. import observability as _obs
-        depth_gauge = wait_hist = None
+        depth_gauge = wait_hist = skip_ctr = None
         if _obs.enabled():
             reg = _obs.metrics.registry()
             depth_gauge = reg.gauge("loader_queue_depth")
             wait_hist = reg.histogram("loader_batch_wait_seconds")
-        live = list(self._rings)
-        w = 0
+            skip_ctr = reg.counter("loader_batches_skipped_total")
+        live = list(range(len(self._rings)))   # slot indices, not rings:
+        w = 0                                  # a respawn swaps the ring
         waited_ms = 0
         wait_t0 = time.perf_counter()
         try:
             while live:
-                ring = live[w % len(live)]
+                slot = live[w % len(live)]
+                ring = self._rings[slot]
                 n = ring.next_len(self._POLL_MS)
                 if n == -2:  # nothing yet: check liveness + user timeout
-                    if self._worker_dead(ring) and \
+                    if self._worker_dead(slot) and \
                             ring.next_len(0) == -2:
-                        raise RuntimeError(
-                            "DataLoader worker process died unexpectedly "
-                            "(killed / OOM?)")
+                        if not self._respawn(slot, "died unexpectedly "
+                                             "(killed / OOM?)"):
+                            raise RuntimeError(
+                                "DataLoader worker process died "
+                                "unexpectedly (killed / OOM?); respawn "
+                                f"budget ({self.max_respawns}) exhausted")
+                        waited_ms = 0
+                        continue
                     waited_ms += self._POLL_MS
                     if 0 <= self._timeout_ms < waited_ms:
-                        raise TimeoutError("DataLoader worker timed out")
+                        if not self._respawn(slot, "timed out (wedged?)"):
+                            raise TimeoutError(
+                                "DataLoader worker timed out; respawn "
+                                f"budget ({self.max_respawns}) exhausted")
+                        waited_ms = 0
                     continue
                 waited_ms = 0
                 if n == -1:  # this worker is done
-                    live.remove(ring)
+                    live.remove(slot)
                     continue
                 payload = ring.read(n)
                 if payload[:1] == b"E":
@@ -348,14 +451,31 @@ class ShmWorkerPool:
                         raise exc from RuntimeError(
                             "DataLoader worker failed:\n" + tb)
                     raise RuntimeError("DataLoader worker failed:\n" + tb)
+                try:
+                    batch = pickle.loads(payload[1:])
+                except Exception as e:
+                    # poisoned/corrupt payload: losing one batch is
+                    # recoverable, killing the run is not — skip, count,
+                    # stay in round-robin order
+                    self._consumed[slot] += 1
+                    if skip_ctr is not None:
+                        skip_ctr.inc()
+                    warnings.warn(
+                        f"DataLoader worker {slot}: corrupt batch payload "
+                        f"({type(e).__name__}: {e}); batch skipped",
+                        RuntimeWarning)
+                    w += 1
+                    wait_t0 = time.perf_counter()
+                    continue
+                self._consumed[slot] += 1
                 if wait_hist is not None:
                     # time from requesting this batch until it was read,
                     # and how many workers have another batch ready (queue
                     # depth: 0 means the consumer is data-starved)
                     wait_hist.observe(time.perf_counter() - wait_t0)
-                    depth_gauge.set(sum(1 for r in live
-                                        if r.next_len(0) >= 0))
-                yield pickle.loads(payload[1:])
+                    depth_gauge.set(sum(1 for s in live
+                                        if self._rings[s].next_len(0) >= 0))
+                yield batch
                 w += 1
                 wait_t0 = time.perf_counter()
         finally:

@@ -14,6 +14,8 @@ import jax.numpy as jnp
 from .. import observability as _obs
 from ..framework import random as _random
 from ..observability import compile_tracker as _ct
+from ..resilience import chaos as _chaos
+from ..resilience import guard as _guard
 from ..tensor import Tensor
 from . import functional_bridge as FB
 
@@ -23,9 +25,15 @@ class TrainStep:
        loss = step(*batch)   # batch of Tensors
 
     loss_fn(model, *batch) -> scalar loss Tensor, evaluated under trace.
+
+    `guard` (a resilience.NonfiniteGuard, or the PADDLE_TPU_GUARD=1
+    default) arms the nonfinite-step guard: the fused program skips the
+    optimizer update on NaN/inf grads and the guard rolls back to the
+    last checkpoint after N consecutive bad steps.  Disabled ⇒ one
+    `is None` check per call.
     """
 
-    def __init__(self, model, loss_fn, optimizer, donate=True):
+    def __init__(self, model, loss_fn, optimizer, donate=True, guard=None):
         import os
         if os.environ.get("PADDLE_TPU_TRACELINT"):
             from .. import analysis as _analysis
@@ -39,6 +47,7 @@ class TrainStep:
         self._donate = donate
         self._opt_state = None
         self._step = 0
+        self._guard = guard if guard is not None else _guard.env_guard()
 
     def _build(self):
         model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
@@ -67,6 +76,9 @@ class TrainStep:
                               or {}).get("need_clip", True)
                   for fz, (_, p) in zip(p_frozen, named)]
 
+        guarded = self._guard is not None
+        guard_fused = guarded and self._guard.mode == "fused"
+
         def step_fn(param_arrays, buffer_arrays, opt_state, lr, step, rng,
                     batch_arrays):
             (loss, new_buffers), grads = jax.value_and_grad(
@@ -74,13 +86,32 @@ class TrainStep:
                     param_arrays, buffer_arrays, rng, batch_arrays)
             grads = [None if fz else g for g, fz in zip(grads, p_frozen)]
             finite = _dbg.finite_flags(loss, grads) if check else None
+
+            ok = _guard.all_finite(loss, grads) if guarded else None
+            if guarded and guard_fused:
+                # nonfinite step, fused mode: zero grads + lr so the
+                # update is a bit-exact param no-op that still runs
+                # in-place under donation (see guard.NonfiniteGuard)
+                grads = _guard.gate_grads(ok, grads)
+                lr = _guard.gate_lr(ok, lr)
             if optimizer._grad_clip is not None:
                 grads = optimizer._clip_grad_arrays(grads,
                                                     need_clip=p_clip)
             new_params, new_opt_state = optimizer.update(
                 grads, param_arrays, opt_state, lr, step,
                 param_names=p_names, lr_scales=p_scales, wd_overrides=p_wds)
-            return loss, new_params, new_buffers, new_opt_state, finite
+            if guarded and not guard_fused:
+                # exact mode: freeze params AND optimizer slots via a
+                # select (forfeits in-place reuse of the donated state)
+                new_params, new_opt_state = _guard.select_tree(
+                    ok, (new_params, new_opt_state),
+                    (param_arrays, opt_state))
+            if guarded:
+                # buffers (running stats) are poisoned by the forward
+                # itself; they are small and not donated — select always
+                new_buffers = _guard.select_tree(ok, new_buffers,
+                                                 buffer_arrays)
+            return loss, new_params, new_buffers, new_opt_state, finite, ok
 
         donate = (0, 2) if self._donate else ()
         self._jitted = jax.jit(step_fn, donate_argnums=donate)
@@ -101,6 +132,9 @@ class TrainStep:
                 pa, frozen=frozen)
             optimizer._state = None  # fused step owns the state now
         if self._jitted is None:
+            # chaos site: a compile failure must surface once and succeed
+            # on retry (self._jitted stays None, so the next call rebuilds)
+            _chaos.crash("compile.fail_once")
             self._build()
         self._step += 1
         lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
@@ -109,6 +143,8 @@ class TrainStep:
         batch_arrays = tuple(
             b._array if isinstance(b, Tensor) else jnp.asarray(b)
             for b in batch)
+        if _chaos._PLAN is not None and _chaos.fire("step.nonfinite"):
+            batch_arrays = _chaos.poison_batch(batch_arrays)
         tok = None
         if _obs.enabled():
             tok = _ct.on_call(
@@ -116,7 +152,7 @@ class TrainStep:
                 _ct.signature_of(list(pa) + list(ba) + list(batch_arrays)),
                 owner=self)
         try:
-            loss, new_params, new_buffers, self._opt_state, finite = \
+            loss, new_params, new_buffers, self._opt_state, finite, ok = \
                 self._jitted(pa, ba, self._opt_state, lr, step, rng,
                              batch_arrays)
         except BaseException:
@@ -134,6 +170,11 @@ class TrainStep:
         buffers = dict(model.named_buffers())
         for n, a in zip(bn, new_buffers):
             buffers[n]._inplace_assign(a)
+        if ok is not None:
+            # AFTER the assignments: a rollback restores checkpoint
+            # params into the model, which must not be overwritten by
+            # this step's (skipped) outputs
+            self._guard.after_step(ok, self)
         optimizer._step_count = self._step
         from ..optimizer.lr import LRScheduler
         if isinstance(optimizer._lr, LRScheduler):
@@ -143,6 +184,24 @@ class TrainStep:
     def state_dict(self):
         return {"opt_state": self._opt_state, "step": self._step}
 
+    # --------------------------------------------------------- resilience
+    def sync_optimizer_state(self):
+        """Hand the fused-step-owned optimizer state back to the eager
+        optimizer so state_dict()/save_state sees the live slots (the
+        fused step keeps ownership; the handed-back reference is only
+        guaranteed fresh until the next __call__)."""
+        if self._opt_state is not None:
+            self.optimizer._state = self._opt_state
+            self.optimizer._step_count = self._step
 
-def train_step(model, loss_fn, optimizer, donate=True):
-    return TrainStep(model, loss_fn, optimizer, donate=donate)
+    def reload_from(self, step=None):
+        """After an external checkpoint restore into (model, optimizer):
+        re-adopt the optimizer's state on the next call and resync the
+        step counter."""
+        self._opt_state = None
+        if step is not None:
+            self._step = int(step)
+
+
+def train_step(model, loss_fn, optimizer, donate=True, guard=None):
+    return TrainStep(model, loss_fn, optimizer, donate=donate, guard=guard)

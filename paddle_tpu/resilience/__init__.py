@@ -1,0 +1,53 @@
+"""paddle_tpu.resilience — fault injection + recovery for production runs.
+
+Four pieces, each observable through the telemetry registry:
+
+  chaos     deterministic fault-injection harness (seeded plans /
+            PADDLE_TPU_CHAOS) firing at named sites across the stack
+  guard     nonfinite-step guard: in-jit fused all-finite check, skip
+            the optimizer step on NaN/inf grads, roll back to the last
+            checkpoint after N consecutive bad steps
+  manager   CheckpointManager: step-numbered retention + GC, torn-
+            checkpoint fallback, SIGTERM preemption flush, mesh-aware
+            restore across world-size changes
+  backoff   shared restart policy (exponential backoff + crash-loop
+            detection) used by distributed/launch and io/shm_loader
+
+See docs/resilience.md.
+"""
+from __future__ import annotations
+
+from . import backoff  # noqa: F401
+from . import chaos  # noqa: F401
+from .backoff import Backoff, CrashLoopDetector  # noqa: F401
+from .chaos import ChaosInterrupt, ChaosPlan  # noqa: F401
+
+chaos.plan_from_env()   # honor PADDLE_TPU_CHAOS=<spec> from process env
+
+__all__ = ["chaos", "backoff", "guard", "manager", "ChaosPlan",
+           "ChaosInterrupt", "Backoff", "CrashLoopDetector",
+           "NonfiniteGuard", "CheckpointManager", "CheckpointError"]
+
+_LAZY = {
+    # guard/manager import jax / framework.checkpoint; loading them here
+    # eagerly would cycle (framework.checkpoint imports resilience.chaos)
+    "guard": ("paddle_tpu.resilience.guard", None),
+    "manager": ("paddle_tpu.resilience.manager", None),
+    "NonfiniteGuard": ("paddle_tpu.resilience.guard", "NonfiniteGuard"),
+    "CheckpointManager": ("paddle_tpu.resilience.manager",
+                          "CheckpointManager"),
+    "CheckpointError": ("paddle_tpu.framework.checkpoint",
+                        "CheckpointError"),
+}
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(name) from None
+    import importlib
+    mod = importlib.import_module(mod_name)
+    val = mod if attr is None else getattr(mod, attr)
+    globals()[name] = val
+    return val

@@ -1,0 +1,64 @@
+"""Shared restart policy: exponential backoff + crash-loop detection.
+
+One policy object serves every supervisor in the stack — the
+`distributed/launch` process runner and the `io/shm_loader` worker pool —
+so "how aggressively do we restart" is defined (and tested) exactly once.
+"""
+from __future__ import annotations
+
+import collections
+import time
+
+
+class Backoff:
+    """Exponential backoff: delay(k) = min(max_delay, base * factor**k).
+
+    `sleep` is injectable so supervisors with their own event loops (or
+    tests) can schedule instead of block.
+    """
+
+    def __init__(self, base=1.0, factor=2.0, max_delay=30.0,
+                 sleep=time.sleep):
+        self.base = float(base)
+        self.factor = float(factor)
+        self.max_delay = float(max_delay)
+        self._sleep = sleep
+
+    def delay(self, attempt):
+        """Delay in seconds before restart number `attempt` (0-based)."""
+        if self.base <= 0:
+            return 0.0
+        return min(self.max_delay, self.base * self.factor ** attempt)
+
+    def wait(self, attempt):
+        d = self.delay(attempt)
+        if d > 0:
+            self._sleep(d)
+        return d
+
+
+class CrashLoopDetector:
+    """Abort-instead-of-burn-restarts: `threshold` failures within
+    `window` seconds means the workload is crash-looping (a deterministic
+    startup failure, a poisoned checkpoint) and restarting cannot help.
+    """
+
+    def __init__(self, threshold=3, window=60.0, clock=time.monotonic):
+        self.threshold = int(threshold)
+        self.window = float(window)
+        self._clock = clock
+        self._failures = collections.deque()
+
+    def record_failure(self):
+        """Record one failure; True when the crash-loop threshold is hit
+        (caller should abort rather than restart)."""
+        now = self._clock()
+        self._failures.append(now)
+        while self._failures and now - self._failures[0] > self.window:
+            self._failures.popleft()
+        return (self.threshold > 0 and
+                len(self._failures) >= self.threshold)
+
+    @property
+    def recent_failures(self):
+        return len(self._failures)

@@ -1,0 +1,301 @@
+"""Deterministic fault injection — the testable half of the resilience layer.
+
+Every recovery path in this stack (nonfinite-step guard, checkpoint
+fallback, loader respawn, launch backoff) is exercised by *injected*
+faults, not by waiting for real outages.  Faults fire at **named sites**
+threaded through the codebase (`chaos.fire("step.nonfinite")` & co.);
+which site fires, and on which hit, is decided by a :class:`ChaosPlan`
+parsed from a spec string — installed programmatically or via the
+``PADDLE_TPU_CHAOS`` environment variable.
+
+Spec grammar (``;``-separated entries)::
+
+    entry   := site [ '@' N ] [ '#' tag ] [ '*' R ] [ '~' P ]
+    site    := dotted name, e.g. step.nonfinite
+    '@' N   := fire on the Nth hit of the site (1-based, default 1)
+    '#' tag := only count hits carrying this tag (e.g. a worker id)
+    '*' R   := keep firing for R consecutive hits ('inf' = forever)
+    '~' P   := instead of '@', fire each hit with probability P drawn
+               from the plan's seeded RNG (deterministic per seed)
+
+Examples::
+
+    step.nonfinite@3            force nonfinite grads on train step 3
+    loader.worker_kill@2#1      kill loader worker 1 on its 2nd batch
+    ckpt.crash_after_arrays@1   crash save_state after the array commit
+    collective.fail_once@1      fail the next collective
+    loader.batch_corrupt~0.1    corrupt ~10% of batches (seeded)
+
+Fault sites (see docs/resilience.md for the full table):
+
+    step.nonfinite              poison the batch → nonfinite loss/grads
+    compile.fail_once           raise inside the jit build
+    collective.fail_once        raise inside an eager collective
+    ckpt.crash_after_meta_stage crash save: meta staged, arrays old
+    ckpt.crash_after_arrays     crash save: arrays committed, meta old
+    save.sigterm                SIGTERM this process mid-save_state
+    loader.worker_kill          loader worker exits hard (SIGKILL-like)
+    loader.worker_hang          loader worker hangs forever
+    loader.batch_corrupt        loader worker ships a corrupt payload
+
+Zero-cost when disabled: every site guards on the module-level
+``_PLAN is None`` check before doing any work.
+"""
+from __future__ import annotations
+
+import os
+import random
+
+_PLAN = None  # module switch: None == chaos disabled (the fast path)
+
+
+class ChaosInterrupt(BaseException):
+    """A simulated crash.  BaseException on purpose: recovery code that
+    catches ``Exception`` (checkpoint fallback, loader skip) must NOT be
+    able to swallow the injected crash itself — only the test harness
+    (or a supervisor) catches it, exactly like a real SIGKILL."""
+
+
+class _Entry:
+    __slots__ = ("site", "at", "tag", "repeat", "prob", "fired")
+
+    def __init__(self, site, at=1, tag=None, repeat=1, prob=None):
+        self.site = site
+        self.at = at
+        self.tag = tag
+        self.repeat = repeat
+        self.prob = prob
+        self.fired = 0
+
+    def __repr__(self):
+        s = self.site
+        if self.prob is not None:
+            s += f"~{self.prob}"
+        else:
+            s += f"@{self.at}"
+        if self.tag is not None:
+            s += f"#{self.tag}"
+        if self.repeat != 1:
+            s += f"*{self.repeat}"
+        return s
+
+
+def _parse_entry(text):
+    # suffix order is free: site@N#tag*R and site#tag@N*R are the same
+    site = text.split("@")[0].split("#")[0].split("*")[0].split("~")[0]
+    vals = {"@": 1, "#": None, "*": 1, "~": None}
+    for sep, conv in (("@", int), ("#", str),
+                      ("*", lambda r: float("inf") if r == "inf"
+                       else int(r)), ("~", float)):
+        if sep in text:
+            raw = text.split(sep, 1)[1]
+            for other in "@#*~":
+                if other != sep:
+                    raw = raw.split(other)[0]
+            vals[sep] = conv(raw)
+    return _Entry(site.strip(), at=vals["@"], tag=vals["#"],
+                  repeat=vals["*"], prob=vals["~"])
+
+
+class ChaosPlan:
+    """A deterministic fault schedule: parsed spec entries + seeded RNG +
+    per-site hit counters.  `should_fire(site, tag)` advances the counter
+    and answers whether a configured fault triggers on this hit."""
+
+    def __init__(self, spec="", seed=0):
+        self.spec = spec
+        self.seed = int(seed)
+        self.entries = [_parse_entry(e) for e in spec.split(";")
+                        if e.strip()]
+        self._rng = random.Random(self.seed)
+        self._hits = {}    # (site, tag|None) -> count
+        self.log = []      # (site, tag, hit_no) for every fired fault
+
+    def should_fire(self, site, tag=None):
+        tag = None if tag is None else str(tag)
+        n_tag = self._hits[(site, tag)] = self._hits.get((site, tag), 0) + 1
+        n_any = None
+        if tag is not None:
+            n_any = self._hits[(site, None)] = \
+                self._hits.get((site, None), 0) + 1
+        fire = False
+        for e in self.entries:
+            if e.site != site or e.fired >= e.repeat:
+                continue
+            if e.tag is not None and e.tag != tag:
+                continue
+            n = n_tag if e.tag is not None or n_any is None else n_any
+            if e.prob is not None:
+                hit = self._rng.random() < e.prob
+            else:
+                hit = n >= e.at
+            if hit:
+                e.fired += 1
+                fire = True
+        if fire:
+            self.log.append((site, tag, n_tag))
+        return fire
+
+    def __repr__(self):
+        return f"ChaosPlan({self.spec!r}, seed={self.seed})"
+
+
+# ---------------------------------------------------------------- install
+def install(plan):
+    """Install a plan (a ChaosPlan or a spec string); returns the plan."""
+    global _PLAN
+    if isinstance(plan, str):
+        plan = ChaosPlan(plan)
+    _PLAN = plan
+    return plan
+
+
+def uninstall():
+    global _PLAN
+    _PLAN = None
+
+
+def active():
+    return _PLAN
+
+
+def plan_from_env():
+    """Install the plan from PADDLE_TPU_CHAOS (with optional
+    PADDLE_TPU_CHAOS_SEED); returns it, or None when the var is unset."""
+    spec = os.environ.get("PADDLE_TPU_CHAOS")
+    if not spec:
+        return None
+    return install(ChaosPlan(spec,
+                             seed=int(os.environ.get(
+                                 "PADDLE_TPU_CHAOS_SEED", "0"))))
+
+
+class scoped:
+    """``with chaos.scoped("step.nonfinite@2") as plan: ...`` — install for
+    the block, always uninstall after (even on the injected crash)."""
+
+    def __init__(self, plan, seed=0):
+        self._plan = plan if isinstance(plan, ChaosPlan) \
+            else ChaosPlan(plan, seed=seed)
+
+    def __enter__(self):
+        install(self._plan)
+        return self._plan
+
+    def __exit__(self, *exc):
+        uninstall()
+        return False
+
+
+# ------------------------------------------------------------- site hooks
+def fire(site, tag=None):
+    """True when the active plan schedules a fault on this hit.  The
+    caller implements the fault (kill, corrupt, poison...)."""
+    p = _PLAN
+    if p is None:
+        return False
+    return p.should_fire(site, tag)
+
+
+def crash(site, tag=None):
+    """Raise ChaosInterrupt when the plan schedules a crash here."""
+    if _PLAN is not None and _PLAN.should_fire(site, tag):
+        raise ChaosInterrupt(site)
+
+
+_LOADER_SITES = {"loader.worker_kill": "kill_at",
+                 "loader.worker_hang": "hang_at",
+                 "loader.batch_corrupt": "corrupt_at"}
+
+
+def take_loader_directives(worker_id):
+    """Consume this worker slot's pending ``loader.*`` faults and return
+    them as positional directives ``{kill_at, hang_at, corrupt_at,
+    corrupt_p}`` (batch ordinals within the worker's slice, 1-based).
+
+    Loader faults are scheduled from the PARENT's plan at spawn time —
+    the parent's counters survive worker death, so a respawned worker
+    does not re-suffer the fault its predecessor already executed (which
+    would turn every injected kill into an infinite crash loop).
+    Probabilistic corrupt entries (``~p``) are not consumed: they apply
+    to every spawn, drawn from the child's seeded RNG.
+    """
+    d = {"kill_at": None, "hang_at": None, "corrupt_at": None,
+         "corrupt_p": None}
+    p = _PLAN
+    if p is None:
+        return d
+    for e in p.entries:
+        key = _LOADER_SITES.get(e.site)
+        if key is None or e.fired >= e.repeat:
+            continue
+        if e.tag is not None and e.tag != str(worker_id):
+            continue
+        if e.site == "loader.batch_corrupt" and e.prob is not None:
+            d["corrupt_p"] = e.prob
+            continue
+        e.fired += 1
+        p.log.append((e.site, str(worker_id), e.at))
+        d[key] = e.at
+    return d
+
+
+# ------------------------------------------------------- fault primitives
+def poison_batch(batch_arrays):
+    """Multiply the first floating-point array by NaN — the deterministic
+    `step.nonfinite` fault: loss AND grads go nonfinite without touching
+    the traced program (the poison rides the batch input)."""
+    import numpy as np
+    out = []
+    done = False
+    for a in batch_arrays:
+        kind = getattr(getattr(a, "dtype", None), "kind", None)
+        if kind is None:  # jax arrays: go through numpy dtype
+            kind = np.dtype(a.dtype).kind if hasattr(a, "dtype") else "?"
+        if not done and kind == "f":
+            out.append(a * float("nan"))
+            done = True
+        else:
+            out.append(a)
+    if not done and out:  # integer-only batch: poison via the first array
+        out[0] = out[0] * 0 + np.iinfo(np.int32).max
+    return tuple(out)
+
+
+def corrupt_checkpoint(path, mode="truncate_arrays"):
+    """Deterministically damage an on-disk checkpoint directory.
+
+    Modes: ``truncate_arrays`` (chop the largest file under arrays/ in
+    half), ``corrupt_meta`` (overwrite meta.json with garbage),
+    ``truncate_meta`` (cut meta.json mid-JSON), ``delete_meta``,
+    ``delete_arrays``.
+    """
+    import shutil
+    arrays_dir = os.path.join(path, "arrays")
+    meta = os.path.join(path, "meta.json")
+    if mode == "truncate_arrays":
+        victim, size = None, -1
+        for root, _, files in os.walk(arrays_dir):
+            for f in files:
+                p = os.path.join(root, f)
+                s = os.path.getsize(p)
+                if s > size:
+                    victim, size = p, s
+        if victim is None:
+            raise FileNotFoundError(f"no array files under {arrays_dir}")
+        with open(victim, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+    elif mode == "corrupt_meta":
+        with open(meta, "w") as f:
+            f.write("\x00garbage{{{")
+    elif mode == "truncate_meta":
+        data = open(meta).read()
+        with open(meta, "w") as f:
+            f.write(data[:max(len(data) // 2, 1)])
+    elif mode == "delete_meta":
+        os.unlink(meta)
+    elif mode == "delete_arrays":
+        shutil.rmtree(arrays_dir)
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    return path

@@ -1,0 +1,200 @@
+"""Nonfinite-step guard: skip poisoned optimizer steps, roll back runs.
+
+A single NaN loss (poisoned batch, fp16 overflow past GradScaler, a
+numerically unstable layer) must not kill a production run.  The guard
+mirrors GradScaler's dynamic-scale protocol for the *unscaled* case:
+
+  in-jit   one fused scalar reduction decides all-finite(loss, grads);
+           when nonfinite, the traced step SELECTS the pre-step params /
+           buffers / optimizer state instead of the updated ones —
+           donation-safe (pure dataflow select, no host round trip
+           inside the program) and free when grads are finite.
+  on host  consecutive bad steps are counted into the telemetry
+           registry; after `max_consecutive` bad steps in a row the
+           guard rolls back to the last retained checkpoint
+           (CheckpointManager.restore) with a FRESH RNG fold — the
+           replayed steps draw different dropout/shuffle randomness, so
+           a transient numerical cliff is dodged instead of replayed.
+
+Enable per step object (``TrainStep(..., guard=NonfiniteGuard(...))``)
+or globally with ``PADDLE_TPU_GUARD=1`` (env: ``PADDLE_TPU_GUARD_N``
+sets the rollback threshold).  Disabled ⇒ a single `is None` check on
+the step path.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+
+def all_finite(loss, grads):
+    """ONE fused scalar: every (nan|inf) anywhere collapses into a single
+    f32 accumulator — `sum(g * 0)` is 0 for finite g and nan otherwise,
+    and the per-tensor partial sums are independent (tree-reduced), not a
+    serial add chain.  Safe under donation: consumes values, never
+    buffers."""
+    parts = [(loss * 0.0).astype(jnp.float32).sum()]
+    parts += [(g * 0.0).astype(jnp.float32).sum()
+              for g in grads if g is not None]
+    return jnp.isfinite(jnp.stack(parts).sum())
+
+
+def select_tree(ok, new, old):
+    """Element-wise pytree select: `new` where the step was finite, `old`
+    (the pre-step state) where it was not.  A `where`, not a `lax.cond`:
+    XLA fuses the select into the producing update, while cond copies
+    every operand through the control-flow boundary (measured ~27% on
+    CPU).  Selecting donated state still forfeits in-place reuse (the
+    old buffer must stay live) — that is what `mode="fused"` avoids."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o), new, old)
+
+
+def gate_grads(ok, grads):
+    """`mode="fused"` gating: zero every gradient when the step verdict
+    is bad.  `where`, not `g * ok` — nan·0 is nan.  Fuses into the
+    optimizer update's read of g, so donation/in-place reuse of params
+    and optimizer slots is preserved."""
+    return [None if g is None else jnp.where(ok, g, jnp.zeros_like(g))
+            for g in grads]
+
+
+def gate_lr(ok, lr):
+    """Zero the learning rate on a bad step: every optimizer `_rule`
+    applies lr multiplicatively in the final param delta, so lr=0 makes
+    new_params bit-exactly the old params."""
+    return jnp.where(ok, lr, jnp.zeros_like(lr))
+
+
+def env_guard():
+    """A NonfiniteGuard when PADDLE_TPU_GUARD=1, else None (checked once
+    per TrainStep construction — zero per-step cost when off)."""
+    if os.environ.get("PADDLE_TPU_GUARD", "0") != "1":
+        return None
+    return NonfiniteGuard(
+        max_consecutive=int(os.environ.get("PADDLE_TPU_GUARD_N", "3")),
+        check_every=int(os.environ.get("PADDLE_TPU_GUARD_EVERY", "1")),
+        mode=os.environ.get("PADDLE_TPU_GUARD_MODE", "fused"))
+
+
+class NonfiniteGuard:
+    """Host-side half of the guard: consecutive-bad-step accounting +
+    checkpoint rollback.
+
+    `manager` (a resilience.CheckpointManager) enables rollback; without
+    one the guard still skips bad steps but raises FloatingPointError
+    once `max_consecutive` is exceeded (failing loudly beats silently
+    treadmilling on a poisoned state).
+
+    `mode` picks the in-jit skip mechanism:
+
+    ``"fused"`` (default)  gate grads and lr to zero on a bad verdict
+        (`where`, nan-safe).  Params and buffers stay bit-exact and the
+        optimizer update keeps its in-place/donation reuse — measured
+        overhead is just the fused all-finite reduction.  Adaptive
+        moments advance one decay step (exactly a zero-gradient batch);
+        after a rollback even that is discarded.
+    ``"exact"``  freeze params, optimizer slots AND moments via a tree
+        select.  Bit-exact "the step never happened", but the select
+        keeps the pre-step state live, forfeiting in-place update reuse
+        (measured ~10% step overhead on a CPU micro-model).
+
+    `check_every` amortizes the host sync: reading the step's verdict
+    scalar blocks until that step's compute finishes, which serializes an
+    otherwise async dispatch pipeline.  With `check_every=k` verdicts
+    accumulate on device and drain every k steps (each is long since
+    materialized — no stall), so skips/rollbacks are detected up to k-1
+    steps late; that lag is safe because a nonfinite step is ALWAYS
+    skipped in-jit — the model state never goes bad, the host just finds
+    out later.  Default 1 = exact, per-step accounting.
+    """
+
+    def __init__(self, max_consecutive=3, manager=None, fold_rng=True,
+                 check_every=1, mode="fused"):
+        if mode not in ("fused", "exact"):
+            raise ValueError(f"guard mode {mode!r}: want 'fused'|'exact'")
+        self.max_consecutive = int(max_consecutive)
+        self.manager = manager
+        self.fold_rng = fold_rng
+        self.mode = mode
+        self.check_every = max(1, int(check_every))
+        self.consecutive = 0
+        self.total_skipped = 0
+        self.rollbacks = 0
+        self._pending = []      # deferred (ok_device, train_step) pairs
+
+    # --------------------------------------------------------------- host
+    def _metrics(self):
+        from ..observability import metrics
+        return metrics.registry()
+
+    def after_step(self, ok, train_step=None):
+        """Record the in-jit verdict; True when a SKIP was detected (with
+        `check_every>1`, detection can lag the skipped step itself)."""
+        if self.check_every == 1:
+            return self._process(ok, train_step)
+        self._pending.append((ok, train_step))
+        if len(self._pending) >= self.check_every:
+            return self.drain()
+        return False
+
+    def drain(self):
+        """Process all deferred verdicts in step order; a rollback
+        discards the verdicts queued after it (they belong to the
+        abandoned timeline).  True when any drained step was skipped."""
+        pending, self._pending = self._pending, []
+        any_skipped = False
+        for ok, ts in pending:
+            before = self.rollbacks
+            any_skipped |= self._process(ok, ts)
+            if self.rollbacks != before:
+                break
+        return any_skipped
+
+    def _process(self, ok, train_step):
+        import numpy as np
+        if bool(np.asarray(ok)):
+            self.consecutive = 0
+            return False
+        self.consecutive += 1
+        self.total_skipped += 1
+        reg = self._metrics()
+        reg.counter("guard_nonfinite_steps_total", source="guard").inc()
+        reg.gauge("guard_consecutive_bad_steps").set(self.consecutive)
+        warnings.warn(
+            f"nonfinite grads/loss: optimizer step skipped "
+            f"({self.consecutive}/{self.max_consecutive} consecutive)",
+            RuntimeWarning)
+        if self.consecutive >= self.max_consecutive:
+            self._rollback(train_step)
+        return True
+
+    def _rollback(self, train_step):
+        if self.manager is None:
+            raise FloatingPointError(
+                f"{self.consecutive} consecutive nonfinite steps and no "
+                f"CheckpointManager attached to the NonfiniteGuard — "
+                f"cannot roll back (attach resilience.CheckpointManager "
+                f"or fix the input pipeline)")
+        meta = self.manager.restore(train_step=train_step)
+        self.rollbacks += 1
+        self.consecutive = 0
+        self._metrics().counter("guard_rollbacks_total").inc()
+        if self.fold_rng:
+            # fresh randomness for the replayed steps: fold the rollback
+            # ordinal into the restored key so dropout/shuffle draws
+            # diverge from the run that hit the cliff
+            from ..framework import random as _random
+            st = _random.get_rng_state()
+            _random.set_rng_state({
+                "key": jax.random.fold_in(st["key"], self.rollbacks),
+                "seed": st["seed"]})
+        warnings.warn(
+            f"rolled back to checkpoint {meta.get('__path__')} at step "
+            f"{meta.get('step')} after {self.max_consecutive} consecutive "
+            f"nonfinite steps (rollback #{self.rollbacks}, fresh RNG "
+            f"fold)", RuntimeWarning)
+        return meta

@@ -1,0 +1,314 @@
+"""Checkpoint manager: retention + GC, torn-checkpoint fallback,
+preemption flush, crash-loop-aware and mesh-aware restore.
+
+Layered on `framework/checkpoint.py` (which owns the single-directory
+atomic save/load protocol): the manager owns a ROOT holding step-numbered
+checkpoints ``<root>/ckpt-<step>``, keeps the newest `max_to_keep`,
+resolves ``latest()`` to the newest checkpoint that passes the light
+consistency probe, and — because the probe is necessarily weaker than a
+full restore — ``restore()`` walks backwards past any checkpoint whose
+deep load raises :class:`CheckpointError` until one loads cleanly.
+
+Preemption: ``install_preemption_handler()`` turns SIGTERM into a
+`preempted` flag plus a flush of any pending async save; the training
+loop (`hapi.callbacks.ResilienceCallback`) sees the flag, writes one
+final checkpoint, and stops cleanly instead of dying mid-epoch.
+
+Mesh-aware restore: every save records the live fleet mesh axes and the
+process/device world size.  When a restart resumes on a *different*
+topology (elastic restart after losing a host), the manager detects the
+mismatch, counts it into telemetry, and restores anyway: arrays are
+persisted as host-gathered (unsharded) numpy, and the fleet engine
+re-places them under the *current* mesh's shardings on the next step —
+the host-bounce instance of portable array redistribution
+(arXiv:2112.01075); an in-HBM collective-permute repath is the planned
+fast path for same-size remaps.
+"""
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import signal as _signal
+import sys
+import warnings
+
+from ..framework import checkpoint as _ckpt
+from . import chaos as _chaos
+
+CheckpointError = None  # set below once framework.checkpoint finishes
+
+
+def _checkpoint_error():
+    # framework.checkpoint may still be mid-import when this module loads
+    # (it imports resilience.chaos); resolve the class lazily
+    global CheckpointError
+    if CheckpointError is None:
+        CheckpointError = _ckpt.CheckpointError
+    return CheckpointError
+
+
+def restart_count():
+    """This process's restart ordinal, exported by distributed/launch as
+    PT_RESTART_COUNT (0 on the first attempt)."""
+    try:
+        return int(os.environ.get("PT_RESTART_COUNT", "0"))
+    except ValueError:
+        return 0
+
+
+def _registry():
+    from ..observability import metrics
+    return metrics.registry()
+
+
+def _mesh_info():
+    """Live mesh topology snapshot recorded with every save."""
+    info = {}
+    try:
+        import jax
+        info["processes"] = int(jax.process_count())
+        info["devices"] = int(jax.device_count())
+    except Exception:
+        pass
+    try:
+        from ..distributed import mesh as mesh_mod
+        if mesh_mod.has_mesh():
+            info["axes"] = {ax: int(mesh_mod.degree(ax))
+                            for ax in ("dp", "mp", "pp", "ep")}
+    except Exception:
+        pass
+    return info
+
+
+class CheckpointManager:
+    """mgr = CheckpointManager(root, max_to_keep=3)
+
+    ``mgr.save(step, model=..., optimizer=...)`` writes
+    ``<root>/ckpt-<step>`` and garbage-collects beyond the retention
+    window; ``mgr.restore(model=..., optimizer=...)`` loads the newest
+    checkpoint that is actually consistent, falling back past torn ones.
+    """
+
+    _DIR_RE = re.compile(r"^(?P<prefix>.+)-(?P<step>\d{8})$")
+
+    def __init__(self, root, max_to_keep=3, prefix="ckpt"):
+        self.root = os.path.abspath(root)
+        self.max_to_keep = int(max_to_keep)
+        self.prefix = prefix
+        os.makedirs(self.root, exist_ok=True)
+        self._pending = None        # outstanding async _SaveHandle
+        self._pending_path = None
+        self._last_save_args = None  # kwargs of the last save (for flush)
+        self.preempted = False
+        self._prev_handlers = {}
+
+    # ----------------------------------------------------------- directory
+    def path_for(self, step):
+        return os.path.join(self.root, f"{self.prefix}-{int(step):08d}")
+
+    def all_steps(self):
+        """Sorted (ascending) step numbers present under root."""
+        steps = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        for n in names:
+            m = self._DIR_RE.match(n)
+            if m and m.group("prefix") == self.prefix:
+                steps.append(int(m.group("step")))
+        return sorted(steps)
+
+    # -------------------------------------------------------- verification
+    def verify(self, path):
+        """Light consistency probe (shared with load_state — see
+        checkpoint.probe): meta.json published and parseable, arrays/
+        committed.  Raises CheckpointError; deep corruption (truncated
+        array files, token mismatch) is caught by load_state during
+        restore()."""
+        _ckpt.probe(path)
+
+    def latest(self):
+        """Path of the newest checkpoint passing the consistency probe
+        (None when the root holds no usable checkpoint).  A torn newest
+        checkpoint — meta unpublished, arrays uncommitted — is skipped,
+        counted, and warned about, never returned."""
+        err = _checkpoint_error()
+        for step in reversed(self.all_steps()):
+            path = self.path_for(step)
+            try:
+                self.verify(path)
+                return path
+            except err as e:
+                _registry().counter("resilience_ckpt_torn_total").inc()
+                warnings.warn(f"skipping torn checkpoint: {e}",
+                              RuntimeWarning)
+        return None
+
+    # ---------------------------------------------------------------- save
+    def save(self, step, model=None, optimizer=None, scaler=None,
+             extra=None, async_save=False, train_step=None):
+        """Write ``<root>/ckpt-<step>`` and GC old checkpoints.
+
+        Pass ``train_step=`` (a jit TrainStep or fleet engine) to
+        checkpoint fused-step-owned optimizer state: the manager hands the
+        state back to the optimizer for the duration of the save.
+        """
+        if train_step is not None:
+            if hasattr(train_step, "sync_optimizer_state"):
+                train_step.sync_optimizer_state()
+            model = model if model is not None else train_step.model
+            if optimizer is None:
+                # fleet engines checkpoint through their own state_dict;
+                # plain TrainSteps hand state back to the eager optimizer
+                from ..jit.train_step import TrainStep as _TS
+                optimizer = (train_step.optimizer
+                             if isinstance(train_step, _TS) else train_step)
+        self.flush()  # a prior async save must publish before the next
+        extra = dict(extra or {})
+        extra.setdefault("mesh", _mesh_info())
+        extra.setdefault("restart_count", restart_count())
+        path = self.path_for(step)
+        self._last_save_args = dict(step=step, model=model,
+                                    optimizer=optimizer, scaler=scaler,
+                                    train_step=train_step)
+        handle = _ckpt.save_state(path, model=model, optimizer=optimizer,
+                                  scaler=scaler, step=step, extra=extra,
+                                  async_save=True)
+        _registry().counter("resilience_ckpt_saves_total").inc()
+        if async_save:
+            self._pending, self._pending_path = handle, path
+            return handle
+        handle.wait_until_finished()
+        self._gc()
+        return None
+
+    def flush(self):
+        """Block until any outstanding async save has fully published."""
+        if self._pending is not None:
+            self._pending.wait_until_finished()
+            self._pending = self._pending_path = None
+            self._gc()
+
+    def _gc(self):
+        steps = self.all_steps()
+        if self.max_to_keep <= 0 or len(steps) <= self.max_to_keep:
+            return
+        for step in steps[:-self.max_to_keep]:
+            path = self.path_for(step)
+            if path == self._pending_path:
+                continue  # never GC a checkpoint still being written
+            shutil.rmtree(path, ignore_errors=True)
+            _registry().counter("resilience_ckpt_gc_total").inc()
+
+    # ------------------------------------------------------------- restore
+    def restore(self, model=None, optimizer=None, scaler=None,
+                train_step=None):
+        """Load the newest checkpoint that restores cleanly, walking
+        backwards past torn/corrupt ones (each fallback is counted and
+        warned).  Returns the meta dict with ``__path__`` added; raises
+        CheckpointError when nothing under root is loadable."""
+        err = _checkpoint_error()
+        if train_step is not None:
+            model = model if model is not None else train_step.model
+            if optimizer is None:
+                from ..jit.train_step import TrainStep as _TS
+                optimizer = train_step.optimizer \
+                    if isinstance(train_step, _TS) else train_step
+        steps = self.all_steps()
+        last_exc = None
+        for step in reversed(steps):
+            path = self.path_for(step)
+            try:
+                self.verify(path)
+                meta = _ckpt.load_state(path, model=model,
+                                        optimizer=optimizer, scaler=scaler)
+            except err as e:
+                last_exc = e
+                _registry().counter(
+                    "resilience_ckpt_fallback_total").inc()
+                warnings.warn(
+                    f"checkpoint fallback: {e}; trying the previous "
+                    f"consistent checkpoint", RuntimeWarning)
+                continue
+            self._after_restore(meta, train_step)
+            meta["__path__"] = path
+            _registry().counter("resilience_ckpt_restores_total").inc()
+            return meta
+        raise err(
+            f"no loadable checkpoint under {self.root} "
+            f"({len(steps)} candidates)" +
+            (f"; last error: {last_exc}" if last_exc else ""),
+            path=self.root)
+
+    def _after_restore(self, meta, train_step):
+        saved_mesh = (meta.get("extra") or {}).get("mesh") or {}
+        cur_mesh = _mesh_info()
+        if saved_mesh and saved_mesh != cur_mesh:
+            # world size / axis degrees changed across the restart: the
+            # host-gathered arrays reshard onto the current mesh when the
+            # engine re-places them (portable redistribution through the
+            # host, arXiv:2112.01075)
+            _registry().counter("resilience_mesh_reshard_total").inc()
+            warnings.warn(
+                f"resuming on a different mesh: checkpoint saved under "
+                f"{saved_mesh}, restoring under {cur_mesh}; host arrays "
+                f"reshard on next placement", RuntimeWarning)
+        if train_step is not None and hasattr(train_step, "reload_from"):
+            train_step.reload_from(step=meta.get("step"))
+
+    # ------------------------------------------------------- preemption
+    def install_preemption_handler(self, signals=(_signal.SIGTERM,),
+                                   exit_process=False, exit_code=143):
+        """Route SIGTERM (preemption notice) into a graceful drain: flush
+        the pending async save, set `preempted` (the fit loop saves one
+        final checkpoint and stops), optionally exit the process."""
+        def _handler(signum, frame):
+            self.preempted = True
+            _registry().counter("resilience_preemptions_total").inc()
+            try:
+                self.flush()
+            except Exception:
+                pass
+            if exit_process:
+                sys.exit(exit_code)
+
+        for sig in signals:
+            if sig in self._prev_handlers:
+                continue   # already installed: keep the ORIGINAL handler
+            try:
+                self._prev_handlers[sig] = _signal.signal(sig, _handler)
+            except ValueError:
+                # not the main thread: the flag-based protocol still
+                # works if the host installs the handler itself
+                warnings.warn(
+                    "install_preemption_handler: not in the main thread; "
+                    "SIGTERM handler not installed", RuntimeWarning)
+        return self
+
+    def uninstall_preemption_handler(self):
+        for sig, prev in self._prev_handlers.items():
+            try:
+                _signal.signal(sig, prev)
+            except ValueError:
+                pass
+        self._prev_handlers.clear()
+
+    def final_save(self):
+        """The preemption flush: one last synchronous save re-using the
+        last save()'s refs, at the train step's current step number when
+        one is attached (save_state overwrites an existing directory
+        atomically, so colliding with a prior save of the same step is
+        safe)."""
+        args = self._last_save_args
+        if not args:
+            return None
+        step = args["step"]
+        ts = args.get("train_step")
+        if ts is not None and getattr(ts, "_step", None) is not None:
+            step = ts._step
+        self.save(int(step), model=args["model"],
+                  optimizer=args["optimizer"], scaler=args["scaler"],
+                  train_step=ts)
+        return self.path_for(int(step))

@@ -1,0 +1,627 @@
+"""Resilience layer: chaos harness, nonfinite guard, checkpoint manager,
+recovery supervisors.
+
+Covers: spec grammar + plan determinism, the four fault families
+end-to-end (tools/chaos_check.py wired in like tracelint --self),
+crash-consistency of chaos-killed saves in BOTH orderings (latest() must
+resolve to the previous good checkpoint), kill->respawn shm_loader
+recovery, forced-NaN rollback with loss continuity after restore,
+launch exponential backoff + crash-loop abort, and the precise
+CheckpointError surface on partial/empty/torn checkpoint dirs.
+"""
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import CheckpointError, nn, optimizer as opt
+from paddle_tpu.framework.checkpoint import load_state, save_state
+from paddle_tpu.io import DataLoader, native
+from paddle_tpu.jit.train_step import TrainStep
+from paddle_tpu.resilience import chaos
+from paddle_tpu.resilience.backoff import Backoff, CrashLoopDetector
+from paddle_tpu.resilience.chaos import ChaosInterrupt, ChaosPlan
+from paddle_tpu.resilience.guard import NonfiniteGuard
+from paddle_tpu.resilience.manager import CheckpointManager
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    chaos.uninstall()
+
+
+def _make_step(guard=None, lr=0.1, momentum=None, seed=7):
+    paddle.seed(seed)
+    model = nn.Linear(4, 2)
+    params = model.parameters()
+    o = (opt.Momentum(learning_rate=lr, momentum=momentum,
+                      parameters=params) if momentum
+         else opt.SGD(learning_rate=lr, parameters=params))
+
+    def loss_fn(m, x, y):
+        return ((m(x) - y) ** 2).mean()
+
+    return model, TrainStep(model, loss_fn, o, guard=guard)
+
+
+def _batch(seed=0):
+    rs = np.random.RandomState(seed)
+    return (paddle.to_tensor(rs.randn(8, 4).astype("float32")),
+            paddle.to_tensor(rs.randn(8, 2).astype("float32")))
+
+
+# ===================================================================
+# chaos: spec grammar + plan semantics
+# ===================================================================
+def test_spec_grammar():
+    p = ChaosPlan("step.nonfinite@3;loader.worker_kill@2#1*2;"
+                  "loader.batch_corrupt~0.25")
+    e0, e1, e2 = p.entries
+    assert (e0.site, e0.at, e0.tag, e0.repeat) == ("step.nonfinite", 3,
+                                                   None, 1)
+    assert (e1.site, e1.at, e1.tag, e1.repeat) == ("loader.worker_kill",
+                                                   2, "1", 2)
+    assert (e2.site, e2.prob) == ("loader.batch_corrupt", 0.25)
+    # suffix order is free
+    q = ChaosPlan("loader.worker_kill#1@2*2").entries[0]
+    assert (q.at, q.tag, q.repeat) == (2, "1", 2)
+    assert ChaosPlan("a.b*inf").entries[0].repeat == float("inf")
+
+
+def test_fire_at_and_repeat():
+    chaos.install(ChaosPlan("s.x@2*2"))
+    assert [chaos.fire("s.x") for _ in range(5)] == [
+        False, True, True, False, False]
+
+
+def test_fire_tagged_counts_per_tag():
+    chaos.install(ChaosPlan("s.x@2#b"))
+    assert not chaos.fire("s.x", tag="a")
+    assert not chaos.fire("s.x", tag="b")   # b's 1st hit
+    assert not chaos.fire("s.x", tag="a")
+    assert chaos.fire("s.x", tag="b")       # b's 2nd hit -> fires
+    assert chaos.active().log == [("s.x", "b", 2)]
+
+
+def test_probabilistic_entries_are_seeded():
+    def draws(seed):
+        p = ChaosPlan("s.x~0.5*inf", seed=seed)
+        return [p.should_fire("s.x") for _ in range(32)]
+    assert draws(3) == draws(3)             # deterministic per seed
+    assert draws(3) != draws(4)             # and seed-sensitive
+
+
+def test_disabled_is_fast_path_and_scoped_cleans_up():
+    assert chaos.active() is None
+    assert not chaos.fire("anything")
+    with chaos.scoped("s.x@1") as plan:
+        assert chaos.active() is plan
+        with pytest.raises(ChaosInterrupt):
+            chaos.crash("s.x")
+    assert chaos.active() is None
+
+
+def test_plan_from_env(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHAOS", "s.y@1")
+    monkeypatch.setenv("PADDLE_TPU_CHAOS_SEED", "9")
+    plan = chaos.plan_from_env()
+    assert plan is chaos.active()
+    assert plan.seed == 9 and plan.entries[0].site == "s.y"
+
+
+def test_chaos_interrupt_not_swallowed_by_except_exception():
+    with pytest.raises(ChaosInterrupt):
+        try:
+            raise ChaosInterrupt("site")
+        except Exception:                    # recovery code's net
+            pytest.fail("ChaosInterrupt must bypass `except Exception`")
+
+
+# ===================================================================
+# backoff + crash loop
+# ===================================================================
+def test_backoff_schedule():
+    b = Backoff(base=1.0, factor=2.0, max_delay=5.0)
+    assert [b.delay(k) for k in range(4)] == [1.0, 2.0, 4.0, 5.0]
+    assert Backoff(base=0).delay(10) == 0.0
+
+
+def test_crash_loop_detector_window():
+    t = [0.0]
+    d = CrashLoopDetector(threshold=3, window=10.0, clock=lambda: t[0])
+    assert not d.record_failure()
+    t[0] = 1.0
+    assert not d.record_failure()
+    t[0] = 20.0                      # first two fall out of the window
+    assert not d.record_failure()
+    t[0] = 21.0
+    assert not d.record_failure()
+    t[0] = 22.0
+    assert d.record_failure()        # 3 failures within 10s -> loop
+
+
+# ===================================================================
+# CheckpointError precision (satellite: no more bare FileNotFoundError)
+# ===================================================================
+def test_load_state_empty_dir_raises_checkpoint_error(tmp_path):
+    d = tmp_path / "empty"
+    d.mkdir()
+    with pytest.raises(CheckpointError) as ei:
+        load_state(str(d))
+    assert ei.value.missing == "meta" and str(d) in str(ei.value)
+
+
+def test_load_state_missing_arrays_raises_checkpoint_error(tmp_path):
+    d = tmp_path / "partial"
+    d.mkdir()
+    (d / "meta.json").write_text("{}")
+    with pytest.raises(CheckpointError) as ei:
+        load_state(str(d))
+    assert ei.value.missing == "arrays"
+
+
+def test_load_state_names_orphaned_tmp(tmp_path):
+    d = tmp_path / "torn"
+    d.mkdir()
+    (d / "meta.json.tmp").write_text("{}")
+    with pytest.raises(CheckpointError, match="meta.json.tmp"):
+        load_state(str(d))
+
+
+def test_corrupt_meta_raises_checkpoint_error(tmp_path):
+    model, ts = _make_step()
+    ts(*_batch())
+    path = str(tmp_path / "ck")
+    save_state(path, model=model)
+    chaos.corrupt_checkpoint(path, "corrupt_meta")
+    with pytest.raises(CheckpointError) as ei:
+        load_state(path, model=model)
+    assert ei.value.missing == "meta"
+
+
+# ===================================================================
+# crash-consistency: chaos-killed save, BOTH orderings
+# ===================================================================
+@pytest.mark.parametrize("site", ["ckpt.crash_after_meta_stage",
+                                  "ckpt.crash_after_arrays"])
+def test_killed_save_falls_back_to_previous_good(tmp_path, site):
+    model, ts = _make_step()
+    ts(*_batch())
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=3)
+    mgr.save(1, train_step=ts)
+    with chaos.scoped(f"{site}@1"):
+        with pytest.raises(ChaosInterrupt):
+            mgr.save(2, train_step=ts)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        assert mgr.latest() == mgr.path_for(1)
+        meta = mgr.restore(train_step=ts)
+    assert meta["step"] == 1
+    # and the torn dir heals on the next save of the same step
+    mgr.save(2, train_step=ts)
+    assert mgr.latest() == mgr.path_for(2)
+    assert not os.path.exists(
+        os.path.join(mgr.path_for(2), "meta.json.tmp"))
+
+
+def test_save_state_cleans_stale_tmp(tmp_path):
+    model, ts = _make_step()
+    ts(*_batch())
+    path = str(tmp_path / "ck")
+    save_state(path, model=model)
+    stale = os.path.join(path, "meta.json.tmp")
+    open(stale, "w").write("{stale}")
+    save_state(path, model=model)        # must not publish the stale stage
+    assert not os.path.exists(stale)
+    load_state(path, model=model)
+
+
+def test_manager_retention_gc(tmp_path):
+    model, ts = _make_step()
+    ts(*_batch())
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    for s in (1, 2, 3, 4):
+        mgr.save(s, train_step=ts)
+    assert mgr.all_steps() == [3, 4]
+    assert mgr.latest() == mgr.path_for(4)
+
+
+def test_manager_deep_fallback_past_truncated_arrays(tmp_path):
+    """verify() passes a truncated-arrays checkpoint (meta is fine) but
+    restore() must still walk back when the deep load fails."""
+    model, ts = _make_step()
+    ts(*_batch())
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=3)
+    mgr.save(1, train_step=ts)
+    mgr.save(2, train_step=ts)
+    chaos.corrupt_checkpoint(mgr.path_for(2), "truncate_arrays")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        meta = mgr.restore(train_step=ts)
+    assert meta["step"] == 1
+
+
+def test_manager_restore_nothing_loadable_raises(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    with pytest.raises(CheckpointError, match="no loadable checkpoint"):
+        mgr.restore()
+
+
+# ===================================================================
+# nonfinite-step guard
+# ===================================================================
+def test_guard_skips_bad_step_and_recovers():
+    g = NonfiniteGuard(max_consecutive=10)
+    model, ts = _make_step(guard=g)
+    x, y = _batch()
+    ts(x, y)
+    w = np.asarray(model.weight.numpy()).copy()
+    with chaos.scoped("step.nonfinite@1"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            loss = ts(x, y)
+    assert not np.isfinite(float(loss.numpy()))      # loss reports truth
+    assert np.allclose(np.asarray(model.weight.numpy()), w)  # no update
+    assert g.total_skipped == 1 and g.consecutive == 1
+    ts(x, y)                                          # finite step resets
+    assert g.consecutive == 0
+    assert not np.allclose(np.asarray(model.weight.numpy()), w)
+
+
+def test_guard_without_manager_raises_after_threshold():
+    g = NonfiniteGuard(max_consecutive=2)
+    model, ts = _make_step(guard=g)
+    x, y = _batch()
+    with chaos.scoped("step.nonfinite@1*2"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            ts(x, y)
+            with pytest.raises(FloatingPointError, match="consecutive"):
+                ts(x, y)
+
+
+def test_forced_nan_rollback_loss_continuity(tmp_path):
+    """THE rollback pin: after N consecutive poisoned steps the guard
+    restores the last checkpoint and the replayed steps produce exactly
+    the losses of a run that never saw the poison (Momentum slots
+    round-trip through the rollback too)."""
+    batches = [_batch(seed=i) for i in range(6)]
+
+    def drive(ts, upto, losses):
+        while ts._step < upto:
+            i = ts._step                     # pre-call index: a rollback
+            val = float(ts(*batches[i]).numpy())   # rewinds _step inside
+            if np.isfinite(val):             # skipped steps record no loss
+                losses[i] = val
+
+    # reference: clean run
+    _, ref = _make_step(momentum=0.9, seed=11)
+    ref_losses = {}
+    drive(ref, 6, ref_losses)
+    ref_w = np.asarray(ref.model.weight.numpy()).copy()
+
+    # chaos run: checkpoint at 2, poison calls 3-4, rollback, replay
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    g = NonfiniteGuard(max_consecutive=2, manager=mgr, fold_rng=False)
+    model, ts = _make_step(guard=g, momentum=0.9, seed=11)
+    losses = {}
+    drive(ts, 2, losses)
+    mgr.save(2, train_step=ts)
+    with chaos.scoped("step.nonfinite@3*2"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            drive(ts, 6, losses)
+    assert g.rollbacks == 1 and g.total_skipped == 2
+    assert np.allclose(np.asarray(model.weight.numpy()), ref_w,
+                       atol=1e-6)
+    for s in range(2, 6):
+        assert np.isclose(losses[s], ref_losses[s], atol=1e-6), \
+            (s, losses[s], ref_losses[s])
+
+
+def test_guard_exact_mode_freezes_optimizer_slots():
+    """mode="exact": a skipped step leaves even the adaptive moments
+    byte-identical (mode="fused" lets them take one decay step)."""
+    g = NonfiniteGuard(max_consecutive=10, mode="exact")
+    model, ts = _make_step(guard=g, momentum=0.9)
+    x, y = _batch()
+    ts(x, y)
+    ts.sync_optimizer_state()
+    vel = [np.asarray(s["velocity"]).copy()
+           for s in ts.optimizer._state]
+    with chaos.scoped("step.nonfinite@1"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            ts(x, y)
+    ts.sync_optimizer_state()
+    for s, v in zip(ts.optimizer._state, vel):
+        assert np.array_equal(np.asarray(s["velocity"]), v)
+    assert g.total_skipped == 1
+
+
+def test_guard_deferred_drain(tmp_path):
+    """check_every=k: verdicts settle at the drain boundary, in step
+    order, and a rollback discards the verdicts queued after it."""
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    g = NonfiniteGuard(max_consecutive=2, manager=mgr, check_every=4,
+                       fold_rng=False)
+    model, ts = _make_step(guard=g)
+    x, y = _batch()
+    ts(x, y)
+    ts(x, y)
+    mgr.save(2, train_step=ts)
+    with chaos.scoped("step.nonfinite@1*2"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            ts(x, y)                         # bad, queued
+            assert g.total_skipped == 0      # ...not yet detected
+            ts(x, y)                         # bad, queued (4th verdict
+            #   completes the window: drain fires inside this call)
+    assert g.total_skipped == 2 and g.rollbacks == 1
+    assert g._pending == []                  # post-rollback queue dropped
+    assert ts._step == 2                     # rewound to the checkpoint
+
+
+def test_guard_disabled_is_single_none_check():
+    model, ts = _make_step(guard=None)
+    assert ts._guard is None                 # env off -> no guard object
+    x, y = _batch()
+    ts(x, y)
+
+
+def test_env_guard(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_GUARD", "1")
+    monkeypatch.setenv("PADDLE_TPU_GUARD_N", "5")
+    model, ts = _make_step()
+    assert isinstance(ts._guard, NonfiniteGuard)
+    assert ts._guard.max_consecutive == 5
+
+
+def test_guard_on_distributed_train_step():
+    """The fleet engine's fused step takes the same guard: in-jit skip
+    (replicated verdict, every shard gates identically), params frozen."""
+    import paddle_tpu.distributed.fleet as fleet
+    fleet.init(is_collective=True, strategy=fleet.DistributedStrategy())
+    paddle.seed(0)
+    model = nn.Linear(8, 4)
+    o = opt.Adam(learning_rate=1e-3, parameters=model.parameters())
+    g = NonfiniteGuard(max_consecutive=10)
+    step = fleet.fleet.build_train_step(
+        model, lambda m, x, y: ((m(x) - y) ** 2).mean(), o, guard=g)
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(16, 8).astype("float32"))
+    y = paddle.to_tensor(rs.randn(16, 4).astype("float32"))
+    step(x, y)
+    w = np.asarray(model.weight.numpy()).copy()
+    with chaos.scoped("step.nonfinite@1"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            bad = step(x, y)
+    assert not np.isfinite(float(bad.numpy()))
+    assert np.allclose(np.asarray(model.weight.numpy()), w)
+    assert g.total_skipped == 1
+    assert np.isfinite(float(step(x, y).numpy()))
+
+
+def test_compile_fail_once_recovers():
+    model, ts = _make_step()
+    x, y = _batch()
+    with chaos.scoped("compile.fail_once@1"):
+        with pytest.raises(ChaosInterrupt):
+            ts(x, y)
+        loss = ts(x, y)                      # retry rebuilds cleanly
+    assert np.isfinite(float(loss.numpy()))
+
+
+# ===================================================================
+# preemption
+# ===================================================================
+def test_sigterm_sets_preempted_and_final_save(tmp_path):
+    model, ts = _make_step()
+    ts(*_batch())
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    mgr.install_preemption_handler()
+    try:
+        mgr.save(1, train_step=ts, async_save=True)
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert mgr.preempted
+        assert mgr.latest() == mgr.path_for(1)   # async save was flushed
+        ts(*_batch())
+        assert mgr.final_save() == mgr.path_for(ts._step)
+    finally:
+        mgr.uninstall_preemption_handler()
+
+
+def test_mesh_change_detected_on_restore(tmp_path, monkeypatch):
+    model, ts = _make_step()
+    ts(*_batch())
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, train_step=ts)
+    import paddle_tpu.resilience.manager as mg
+    monkeypatch.setattr(mg, "_mesh_info",
+                        lambda: {"processes": 2, "devices": 16})
+    with pytest.warns(RuntimeWarning, match="different mesh"):
+        meta = mgr.restore(train_step=ts)
+    assert meta["step"] == 1
+
+
+# ===================================================================
+# shm_loader recovery
+# ===================================================================
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="native ring unavailable")
+
+
+class _SeqDataset:
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        return np.full((4,), i, dtype=np.float32)
+
+
+def _collect(dl):
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        batches = [np.asarray(b.numpy()) for b in dl]
+    return batches, [str(x.message) for x in w]
+
+
+@needs_native
+def test_loader_kill_respawn_preserves_batches():
+    with chaos.scoped("loader.worker_kill@2#0"):
+        dl = DataLoader(_SeqDataset(), batch_size=2, num_workers=2)
+        batches, msgs = _collect(dl)
+    assert [int(b[0, 0]) for b in batches] == list(range(0, 16, 2))
+    assert any("respawning" in m for m in msgs)
+
+
+@needs_native
+def test_loader_kill_budget_exhausted_raises():
+    with chaos.scoped("loader.worker_kill@1#0*inf"):
+        dl = DataLoader(_SeqDataset(), batch_size=2, num_workers=1,
+                        max_respawns=1)
+        with pytest.raises(RuntimeError, match="respawn budget"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                list(dl)
+
+
+@needs_native
+def test_loader_corrupt_batch_skipped_not_fatal():
+    with chaos.scoped("loader.batch_corrupt@1#1"):
+        dl = DataLoader(_SeqDataset(), batch_size=2, num_workers=2)
+        batches, msgs = _collect(dl)
+    assert len(batches) == 7                  # one poisoned batch dropped
+    assert any("batch skipped" in m for m in msgs)
+
+
+@needs_native
+@pytest.mark.slow
+def test_loader_hang_timeout_respawn():
+    with chaos.scoped("loader.worker_hang@1#0"):
+        dl = DataLoader(_SeqDataset(), batch_size=2, num_workers=2,
+                        timeout=2)
+        batches, msgs = _collect(dl)
+    assert len(batches) == 8
+    assert any("wedged" in m for m in msgs)
+
+
+# ===================================================================
+# launch: backoff + crash loop + PT_RESTART_COUNT
+# ===================================================================
+def _write(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def test_launch_backoff_and_restart_count(tmp_path):
+    from paddle_tpu.distributed import launch
+    script = _write(tmp_path, "flaky.py", """
+        import os, sys
+        d = os.path.dirname(os.path.abspath(__file__))
+        marker = os.path.join(d, "attempts")
+        n = int(open(marker).read()) if os.path.exists(marker) else 0
+        open(marker, "w").write(str(n + 1))
+        open(os.path.join(d, f"rc{n}"), "w").write(
+            os.environ.get("PT_RESTART_COUNT", "?"))
+        sys.exit(1 if n < 2 else 0)
+    """)
+    t0 = time.monotonic()
+    code = launch.run(["--nproc_per_node", "1", "--max_restarts", "3",
+                       "--restart_backoff", "0.2",
+                       "--crash_loop_threshold", "0", script])
+    assert code == 0
+    assert (tmp_path / "attempts").read_text() == "3"
+    assert [(tmp_path / f"rc{i}").read_text() for i in range(3)] == \
+        ["0", "1", "2"]
+    assert time.monotonic() - t0 >= 0.6       # 0.2s + 0.4s backoffs
+
+
+def test_launch_crash_loop_aborts_early(tmp_path):
+    from paddle_tpu.distributed import launch
+    script = _write(tmp_path, "dead.py", "import sys; sys.exit(7)\n")
+    code = launch.run(["--nproc_per_node", "1", "--max_restarts", "99",
+                       "--restart_backoff", "0.05",
+                       "--crash_loop_threshold", "3",
+                       "--crash_loop_window", "60", script])
+    assert code == 7                          # aborted, not 99 restarts
+
+
+# ===================================================================
+# hapi: ResilienceCallback auto-resume
+# ===================================================================
+def _fit_model(tmp_path, epochs, callbacks=None):
+    import paddle_tpu.hapi as hapi
+    paddle.seed(123)
+    net = nn.Linear(4, 1)
+    m = hapi.Model(net)
+    m.prepare(optimizer=opt.SGD(learning_rate=0.05,
+                                parameters=net.parameters()),
+              loss=nn.MSELoss())
+    rs = np.random.RandomState(42)
+    X = rs.randn(32, 4).astype("float32")
+    Y = (X @ rs.randn(4, 1)).astype("float32")
+    ds = [(X[i], Y[i]) for i in range(32)]
+    m.fit(ds, batch_size=8, epochs=epochs, verbose=0, shuffle=False,
+          callbacks=callbacks)
+    return m
+
+
+def test_resilience_callback_resume_matches_uninterrupted(tmp_path,
+                                                          capsys):
+    from paddle_tpu.hapi import ResilienceCallback
+    ref = _fit_model(tmp_path, epochs=3)
+    ref_w = np.asarray(ref.network.weight.numpy()).copy()
+
+    ck = str(tmp_path / "ck")
+    _fit_model(tmp_path, epochs=2, callbacks=[
+        ResilienceCallback(checkpoint_dir=ck, save_freq=1,
+                           async_save=False)])
+    resumed = _fit_model(tmp_path, epochs=1, callbacks=[
+        ResilienceCallback(checkpoint_dir=ck, save_freq=1,
+                           async_save=False)])
+    assert "resumed from" in capsys.readouterr().out
+    assert np.allclose(np.asarray(resumed.network.weight.numpy()),
+                       ref_w, atol=1e-6)
+
+
+# ===================================================================
+# the seeded chaos plan, end-to-end (tier-1 wiring of chaos_check)
+# ===================================================================
+def _load_chaos_check():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "chaos_check", os.path.join(REPO, "tools", "chaos_check.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_chaos_check_inprocess():
+    """All four fault families under one seeded plan; the recovered run
+    must match the uninterrupted reference exactly."""
+    import io
+    buf = io.StringIO()
+    assert _load_chaos_check().run(out=buf) == 0, buf.getvalue()
+    assert "all four fault families recovered" in buf.getvalue()
+
+
+@pytest.mark.slow
+def test_chaos_check_subprocess():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_check.py")],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""chaos_check — run the seeded chaos plan end-to-end on a tiny model.
+
+The tier-1 resilience drill (wired in like ``tools/tracelint.py --self``):
+one deterministic :class:`ChaosPlan` exercises all four fault families —
+
+  1. loader kill        a shm_loader worker dies on its 2nd batch and is
+                        respawned; every batch still arrives, in order
+  2. nonfinite step     three consecutive poisoned batches trip the
+                        guard: two skips, then rollback to the last
+                        retained checkpoint
+  3. torn checkpoint    a save crashes after the array commit; the
+                        manager resolves latest() past the torn dir
+  4. mid-save SIGTERM   preemption lands during save_state; the handler
+                        flushes, flags, and a fresh train step resumes
+                        IN THE SAME PROCESS
+
+and the recovered run must land on **exactly** the weights/losses of an
+uninterrupted reference run over the same batch schedule.  Any drift —
+a dropped batch, a half-applied optimizer step, a stale Momentum slot —
+fails the drill.
+
+Usage:  python tools/chaos_check.py [-v]
+Exit 0 = all recovery paths green.
+"""
+import argparse
+import io
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_STEPS = 10        # total optimizer steps in the drill
+BATCHES = 8         # dataset of 16 samples / batch 2, two loader workers
+SPEC = ("loader.worker_kill@2#0;"     # family 1: kill worker 0, batch 2
+        "step.nonfinite@4*3;"         # family 2: poison step calls 4-6
+        "ckpt.crash_after_arrays@2;"  # family 3: tear the 2nd save
+        "save.sigterm@3")             # family 4: SIGTERM inside save 3
+SEED = 0
+
+
+class _DrillDataset:
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        import numpy as np
+        x = np.linspace(0.1 * i, 0.1 * i + 1, 4, dtype=np.float32)
+        y = np.asarray([0.3 * i], dtype=np.float32)
+        return x, y
+
+
+def _fresh_step(guard=None):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer as opt
+    from paddle_tpu.jit.train_step import TrainStep
+    paddle.seed(1234)   # identical init for reference / chaos / resumed
+    model = nn.Linear(4, 1)
+    o = opt.Momentum(learning_rate=0.05, momentum=0.9,
+                     parameters=model.parameters())
+
+    def loss_fn(m, x, y):
+        return ((m(x) - y) ** 2).mean()
+
+    return model, TrainStep(model, loss_fn, o, guard=guard)
+
+
+def _drive(ts, batches, upto, losses=None):
+    """Advance the train step to `upto` optimizer steps, feeding
+    ``batches[_step % len]`` — self-correcting across a guard rollback
+    (which rewinds ``_step``)."""
+    while ts._step < upto:
+        i = ts._step % len(batches)
+        loss = ts(*batches[i])
+        if losses is not None:
+            losses[ts._step] = float(loss.numpy())
+    return ts
+
+
+def run(out=None, verbose=False):
+    out = out if out is not None else sys.stdout
+    import tempfile
+    import shutil
+    import warnings
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.resilience.chaos import ChaosInterrupt
+    from paddle_tpu.resilience.guard import NonfiniteGuard
+    from paddle_tpu.resilience.manager import CheckpointManager
+
+    def log(msg):
+        if verbose:
+            print(msg, file=out)
+
+    root = tempfile.mkdtemp(prefix="chaos_check_")
+    failures = []
+    try:
+        # ---- reference: batch schedule + uninterrupted training --------
+        ref_batches = [tuple(b if isinstance(b, (list, tuple)) else [b])
+                       for b in DataLoader(_DrillDataset(), batch_size=2,
+                                           num_workers=0)]
+        assert len(ref_batches) == BATCHES
+        _, ref_ts = _fresh_step()
+        ref_losses = {}
+        _drive(ref_ts, ref_batches, N_STEPS, ref_losses)
+        ref_w = np.asarray(ref_ts.model.weight.numpy()).copy()
+        log(f"reference run: {N_STEPS} steps, final loss "
+            f"{ref_losses[N_STEPS - 1]:.6f}")
+
+        plan = chaos.ChaosPlan(SPEC, seed=SEED)
+        chaos.install(plan)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+
+            # ---- family 1: loader worker kill -> respawn ---------------
+            got = [tuple(b if isinstance(b, (list, tuple)) else [b])
+                   for b in DataLoader(_DrillDataset(), batch_size=2,
+                                       num_workers=2)]
+            if len(got) != BATCHES:
+                failures.append(
+                    f"loader kill: {len(got)} batches arrived, "
+                    f"want {BATCHES}")
+            else:
+                for i, (g, r) in enumerate(zip(got, ref_batches)):
+                    for ga, ra in zip(g, r):
+                        if not np.allclose(np.asarray(ga.numpy()),
+                                           np.asarray(ra.numpy())):
+                            failures.append(
+                                f"loader kill: batch {i} content drift "
+                                f"after respawn")
+                            break
+            if not any(s == "loader.worker_kill" for s, _, _ in plan.log):
+                failures.append("loader kill: fault never fired")
+            log("family 1 (loader kill -> respawn): "
+                f"{len(got)} batches, order preserved")
+
+            # ---- family 2: nonfinite steps -> skip, skip, rollback -----
+            mgr = CheckpointManager(root, max_to_keep=3)
+            guard = NonfiniteGuard(max_consecutive=3, manager=mgr,
+                                   fold_rng=False)
+            model, ts = _fresh_step(guard=guard)
+            chaos_losses = {}
+            _drive(ts, ref_batches, 2, chaos_losses)
+            mgr.save(2, train_step=ts)                      # save #1: good
+            _drive(ts, ref_batches, 6, chaos_losses)  # calls 4-6 poisoned:
+            #   two skips, a third trips rollback to ckpt-2, then the
+            #   rewound _step makes _drive replay 3..6 clean
+            if guard.total_skipped != 3 or guard.rollbacks != 1:
+                failures.append(
+                    f"guard: skipped={guard.total_skipped} (want 3) "
+                    f"rollbacks={guard.rollbacks} (want 1)")
+            log(f"family 2 (nonfinite guard): {guard.total_skipped} "
+                f"skips, {guard.rollbacks} rollback, replay clean")
+
+            # ---- family 3: torn save -> latest() falls back ------------
+            try:
+                mgr.save(6, train_step=ts)                  # save #2: torn
+                failures.append("torn save: ChaosInterrupt not raised")
+            except ChaosInterrupt:
+                pass
+            if mgr.latest() != mgr.path_for(2):
+                failures.append(
+                    f"torn save: latest()={mgr.latest()}, want ckpt-2")
+            log("family 3 (torn checkpoint): latest() fell back past "
+                "the torn ckpt-6")
+
+            # ---- family 4: SIGTERM mid-save -> flagged, final save -----
+            mgr.install_preemption_handler()
+            try:
+                mgr.save(6, train_step=ts)          # save #3: preempted
+                if not mgr.preempted:
+                    failures.append(
+                        "preemption: SIGTERM during save not flagged")
+            finally:
+                mgr.uninstall_preemption_handler()
+            if mgr.latest() != mgr.path_for(6):
+                failures.append(
+                    f"preemption: latest()={mgr.latest()}, want ckpt-6 "
+                    f"(the mid-SIGTERM save must still publish)")
+            log("family 4 (mid-save SIGTERM): preempted flag set, "
+                "ckpt-6 published")
+
+            # ---- resume IN THE SAME PROCESS ----------------------------
+            mgr2 = CheckpointManager(root, max_to_keep=3)
+            model2, ts2 = _fresh_step()
+            meta = mgr2.restore(train_step=ts2)
+            if meta.get("step") != 6:
+                failures.append(
+                    f"resume: restored step {meta.get('step')}, want 6")
+            _drive(ts2, ref_batches, N_STEPS, chaos_losses)
+        chaos.uninstall()
+
+        got_w = np.asarray(model2.weight.numpy())
+        if not np.allclose(got_w, ref_w, atol=1e-6):
+            failures.append(
+                f"resume: final weights drift "
+                f"{np.abs(got_w - ref_w).max():.3e} from the "
+                f"uninterrupted reference")
+        for s in range(6, N_STEPS):
+            if not np.isclose(chaos_losses[s], ref_losses[s], atol=1e-6):
+                failures.append(
+                    f"resume: loss at recovered step {s} = "
+                    f"{chaos_losses[s]:.6f}, reference "
+                    f"{ref_losses[s]:.6f}")
+        log(f"resume: steps 6..{N_STEPS - 1} losses match the reference "
+            f"exactly")
+    finally:
+        chaos.uninstall()
+        shutil.rmtree(root, ignore_errors=True)
+
+    if failures:
+        print("chaos_check FAILED:", file=out)
+        for f in failures:
+            print(f"  - {f}", file=out)
+        return 1
+    print(f"chaos_check OK: plan {SPEC!r} seed={SEED} — all four fault "
+          f"families recovered; resumed run matches the uninterrupted "
+          f"reference", file=out)
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    return run(verbose=args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
