@@ -12,11 +12,12 @@ import jax
 from . import mesh  # noqa: F401
 from .mesh import build_mesh, get_mesh, set_mesh  # noqa: F401
 from .collective import (  # noqa: F401
-    ReduceOp, all_reduce, all_gather, reduce_scatter, broadcast, scatter,
-    alltoall, alltoall_single, barrier, ppermute, stream_synchronize,
-    reduce, send, recv, isend, irecv, all_gather_object,
-    broadcast_object_list, scatter_object_list, get_group,
-    destroy_process_group, split,
+    CollectiveTimeout, ReduceOp, all_reduce, all_gather, reduce_scatter,
+    broadcast, scatter, alltoall, alltoall_single, barrier, ppermute,
+    stream_synchronize, reduce, send, recv, isend, irecv,
+    all_gather_object, broadcast_object_list, scatter_object_list,
+    get_group, destroy_process_group, split, configure_collectives,
+    collective_policy,
 )
 from . import launch  # noqa: F401
 from .recompute import recompute  # noqa: F401
@@ -44,6 +45,10 @@ def init_parallel_env():
     import os
     if _env["initialized"]:
         return
+    # launched under a heartbeat-watching supervisor: start beating so
+    # the launcher can tell a hang from a crash (no-op otherwise)
+    from .launch.heartbeat import start_heartbeat
+    start_heartbeat()
     if os.environ.get("PT_COORDINATOR"):
         jax.distributed.initialize(
             coordinator_address=os.environ["PT_COORDINATOR"],

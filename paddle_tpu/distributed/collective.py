@@ -6,11 +6,39 @@ TPU-native double life:
     (psum/all_gather/ppermute) riding ICI;
   * eagerly in a single-controller process they are identity ops (world=1
     per process — jax is single-controller, data lives globally sharded).
+
+Robustness (resilience PR 6): every accounted collective runs under a
+configurable timeout/retry/backoff policy (:func:`configure_collectives`
+or ``PADDLE_TPU_COLLECTIVE_TIMEOUT`` / ``_RETRIES`` / ``_BACKOFF``).  A
+hung eager collective is abandoned at the deadline (the NCCL-watchdog
+model — jax cannot preempt an issued XLA program, so the attempt runs on
+a daemon thread and :class:`CollectiveTimeout` surfaces to the retry
+loop); failed or timed-out attempts are retried with exponential backoff
+and counted per collective (``collective_timeout_total`` /
+``collective_retry_total`` / ``collective_failures_total``, labeled by
+op), with a straggler warning naming the mesh axis.  Traced collectives
+(shard_map/jit bodies) run inline with no deadline — tracers are
+thread-bound — and real in-program hangs are the launch supervisor's
+heartbeat to catch.  Disabled (the default) this is a single ``is
+None`` check per call.
+
+Multi-controller caveat: an abandoned attempt cannot be cancelled (jax
+exposes no communicator teardown, unlike the NCCL watchdog this
+imitates), so if it later completes, the retry has issued the same
+collective TWICE on this rank only — peers issued it once, and the
+SPMD op sequence can desync.  Arm the retry budget in multi-controller
+runs only when a timed-out attempt means the fleet is being torn down
+anyway (the supervisor's heartbeat kill + restart path); the
+single-controller / chaos-injection paths have no such hazard because
+the "collective" is process-local.
 """
 from __future__ import annotations
 
 import inspect
+import os
+import threading
 import time
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -18,9 +46,108 @@ from jax import lax
 
 from ..tensor import Tensor
 
+try:
+    from jax.core import trace_state_clean as _trace_state_clean
+except ImportError:  # not re-exported in every jax release
+    from jax._src.core import trace_state_clean as _trace_state_clean
+
 # Telemetry sink (observability.enable() installs a _CommsTelemetry;
 # None means disabled — collectives then run with zero accounting cost).
 _TELEMETRY = None
+
+
+class CollectiveTimeout(RuntimeError):
+    """A collective exceeded its deadline (watchdog-abandoned or chaos-
+    injected).  RuntimeError subclass so retry surfaces treat it as a
+    transport fault, not a programming error."""
+
+
+class CollectivePolicy:
+    """Timeout/retry policy for eager collectives: per-attempt `timeout`
+    seconds (None = no deadline), `retries` extra attempts after the
+    first, exponential backoff between attempts (resilience.backoff)."""
+
+    __slots__ = ("timeout", "retries", "backoff")
+
+    def __init__(self, timeout=None, retries=0, backoff_base=0.5,
+                 backoff_factor=2.0, backoff_max=10.0, sleep=time.sleep):
+        from ..resilience.backoff import Backoff
+        self.timeout = None if timeout is None else float(timeout)
+        self.retries = int(retries)
+        self.backoff = Backoff(base=backoff_base, factor=backoff_factor,
+                               max_delay=backoff_max, sleep=sleep)
+
+
+_POLICY = None  # None == robustness machinery disabled (the fast path)
+
+
+def configure_collectives(timeout=None, retries=0, **backoff_kwargs):
+    """Install the collective timeout/retry policy; all-default arguments
+    clear it.  Returns the active policy (or None when cleared)."""
+    global _POLICY
+    if timeout is None and retries == 0 and not backoff_kwargs:
+        _POLICY = None
+    else:
+        _POLICY = CollectivePolicy(timeout=timeout, retries=retries,
+                                   **backoff_kwargs)
+    return _POLICY
+
+
+def collective_policy():
+    return _POLICY
+
+
+def policy_from_env():
+    """Install the policy from PADDLE_TPU_COLLECTIVE_TIMEOUT (seconds) /
+    PADDLE_TPU_COLLECTIVE_RETRIES / PADDLE_TPU_COLLECTIVE_BACKOFF (base
+    seconds); returns it, or None when neither var is set."""
+    t = os.environ.get("PADDLE_TPU_COLLECTIVE_TIMEOUT")
+    r = os.environ.get("PADDLE_TPU_COLLECTIVE_RETRIES")
+    if not t and not r:
+        return None
+    return configure_collectives(
+        timeout=float(t) if t else None, retries=int(r or 0),
+        backoff_base=float(os.environ.get(
+            "PADDLE_TPU_COLLECTIVE_BACKOFF", "0.5")))
+
+
+def _metrics():
+    from ..observability import metrics
+    return metrics.registry()
+
+
+def _run_with_deadline(call, timeout, hang_s=0.0):
+    """One collective attempt under a watchdog deadline.  jax cannot
+    preempt an issued XLA program, so the attempt runs on a daemon
+    worker thread and the caller joins with the timeout — on expiry the
+    worker is abandoned (the NCCL-watchdog model) and CollectiveTimeout
+    surfaces to the retry loop.  Only for EAGER calls: under an active
+    trace, tracers are thread-bound, so the attempt runs inline with no
+    deadline (`hang_s` is the chaos `collective.hang` stall)."""
+    if timeout is None or not _trace_state_clean():
+        if hang_s:
+            time.sleep(hang_s)
+        return call()
+    box = {}
+
+    def _target():
+        try:
+            if hang_s:
+                time.sleep(hang_s)
+            box["ok"] = call()
+        except BaseException as e:   # noqa: BLE001 — relayed to caller
+            box["err"] = e
+
+    th = threading.Thread(target=_target, daemon=True,
+                          name="collective-attempt")
+    th.start()
+    th.join(timeout)
+    if th.is_alive():
+        raise CollectiveTimeout(
+            f"collective exceeded the {timeout:.3g}s deadline")
+    if "err" in box:
+        raise box["err"]
+    return box["ok"]
 
 
 def _payload_nbytes(x):
@@ -42,24 +169,28 @@ def _payload_nbytes(x):
 
 
 def _accounted(payload_arg):
-    """Decorator: record (op, payload bytes, mesh axis, wall time) per call
-    when telemetry is on.  `payload_arg` names the parameter carrying the
-    payload; the axis comes from `group` (or `axis_name` for ppermute)."""
+    """Decorator: robustness + accounting for one collective family.
+    Per call, when any machinery is armed (policy / chaos / telemetry):
+    chaos sites `collective.fail_once` / `collective.timeout` /
+    `collective.hang` fire first; each attempt runs under the policy's
+    watchdog deadline and records (op, payload bytes, mesh axis, wall
+    time) when telemetry is on; timeouts and transport failures are
+    counted per op, retried with backoff up to the policy's budget, and
+    stragglers are warned about naming the mesh axis.  `payload_arg`
+    names the parameter carrying the payload; the axis comes from
+    `group` (or `axis_name` for ppermute)."""
     def deco(fn):
         import functools
         sig = inspect.signature(fn)
+        op = fn.__name__
 
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
             from ..resilience import chaos as _chaos
-            if _chaos._PLAN is not None and \
-                    _chaos.fire("collective.fail_once", tag=fn.__name__):
-                raise RuntimeError(
-                    f"chaos: injected collective failure in "
-                    f"{fn.__name__}")
+            pol = _POLICY
             tel = _TELEMETRY
-            if tel is None:
-                return fn(*args, **kwargs)
+            if pol is None and tel is None and _chaos._PLAN is None:
+                return fn(*args, **kwargs)       # everything disabled
             try:
                 bound = sig.bind(*args, **kwargs)
                 payload = bound.arguments.get(payload_arg)
@@ -67,13 +198,73 @@ def _accounted(payload_arg):
                     bound.arguments.get("group"))
             except TypeError:
                 payload, axis = None, "?"
-            nbytes = _payload_nbytes(payload)
-            t0 = time.perf_counter()
-            try:
+
+            def attempt():
                 return fn(*args, **kwargs)
-            finally:
-                tel.record(fn.__name__, nbytes, axis, t0,
-                           time.perf_counter() - t0)
+
+            timeout = pol.timeout if pol is not None else None
+            retries = pol.retries if pol is not None else 0
+            attempts = 0
+            while True:
+                try:
+                    hang_s = 0.0
+                    if _chaos._PLAN is not None:
+                        if _chaos.fire("collective.fail_once", tag=op):
+                            raise RuntimeError(
+                                f"chaos: injected collective failure "
+                                f"in {op}")
+                        if _chaos.fire("collective.timeout", tag=op):
+                            raise CollectiveTimeout(
+                                f"chaos: injected collective timeout "
+                                f"in {op}")
+                        if _chaos.fire("collective.hang", tag=op):
+                            # stall past the deadline so the REAL
+                            # watchdog path (abandon + retry) runs;
+                            # without a deadline there is no watchdog
+                            # to exercise, so warn instead of wedging
+                            # the caller in an unrecoverable sleep
+                            if timeout:
+                                hang_s = timeout * 2.0
+                            else:
+                                warnings.warn(
+                                    f"chaos: collective.hang fired in "
+                                    f"{op} but no policy timeout is "
+                                    f"armed — skipping the stall (set "
+                                    f"PADDLE_TPU_COLLECTIVE_TIMEOUT or "
+                                    f"configure_collectives to exercise "
+                                    f"the watchdog path)",
+                                    RuntimeWarning)
+                    t0 = time.perf_counter()
+                    out = _run_with_deadline(attempt, timeout,
+                                             hang_s=hang_s)
+                    if tel is not None:
+                        # recorded only on the delivered attempt — a
+                        # watchdog-abandoned thread that completes late
+                        # must not double-count the op
+                        tel.record(op, _payload_nbytes(payload), axis,
+                                   t0, time.perf_counter() - t0)
+                    return out
+                except (CollectiveTimeout, RuntimeError) as e:
+                    reg = _metrics()
+                    if isinstance(e, CollectiveTimeout):
+                        reg.counter("collective_timeout_total",
+                                    op=op).inc()
+                        warnings.warn(
+                            f"collective straggler: {op} on mesh axis "
+                            f"{axis!r} hit its deadline ({e})",
+                            RuntimeWarning)
+                    else:
+                        reg.counter("collective_failures_total",
+                                    op=op).inc()
+                    if attempts >= retries:
+                        raise
+                    attempts += 1
+                    reg.counter("collective_retry_total", op=op).inc()
+                    warnings.warn(
+                        f"collective retry {attempts}/{retries}: {op} "
+                        f"on mesh axis {axis!r} after: {e}",
+                        RuntimeWarning)
+                    pol.backoff.wait(attempts - 1)
         return wrapper
     return deco
 
@@ -487,3 +678,6 @@ def split(tensor, num_or_sections, axis=0, group=None):
     for API parity as a local split."""
     from ..tensor_api import split as _split
     return _split(tensor, num_or_sections, axis=axis)
+
+
+policy_from_env()   # honor PADDLE_TPU_COLLECTIVE_* from process env

@@ -285,8 +285,12 @@ class DistributedTrainStep:
         if prev_sync is not None and prev_sync != self.sync_model:
             prev_sync()
         params, p_specs, p_sh, b_sh = self._shardings()
+        from ..resilience import reshard as _reshard_mod
         for p, sh in zip(params, p_sh):
-            p._inplace_assign(jax.device_put(p._array, sh))
+            # reshard-aware placement: a param restored (or trained)
+            # under a DIFFERENT mesh redistributes via the planned
+            # collective decomposition instead of a blind device_put
+            p._inplace_assign(_reshard_mod.place(p._array, sh))
         buffers = list(dict(self.model.named_buffers()).values())
         for b, sh in zip(buffers, b_sh):
             b._inplace_assign(jax.device_put(b._array, sh))
@@ -428,6 +432,42 @@ class DistributedTrainStep:
             if key in sd:
                 slots[s] = sd[key]
         self._pending_sd = None
+
+    def restore_shardings(self):
+        """Target shardings for a cross-mesh checkpoint restore, keyed by
+        checkpoint tree path: ``model/<param>`` / ``model/<buffer>`` map
+        to concrete NamedShardings on the current mesh, and
+        ``optimizer/<param>`` prefixes map to ``shape -> NamedSharding``
+        callables (slot shapes are only known at restore time).
+        CheckpointManager.restore feeds this to resilience.reshard so a
+        resized-mesh restart redistributes arrays device-side instead of
+        bouncing them through replicated host copies.  pp-stacked block
+        leaves are topology-bound and keep the host path (no entry
+        here)."""
+        if not mesh_mod.has_mesh():
+            return {}
+        mesh = mesh_mod.get_mesh()
+        stage = self.sharding_stage
+        targets = {}
+        pp_outer = None
+        if self.use_pp:
+            outer_named, _, _, _ = self._pp_split()
+            pp_outer = {n for n, _ in outer_named}
+
+        def _slot_target(p_spec):
+            return lambda shape: NamedSharding(
+                mesh, state_pspec(p_spec, shape, stage))
+
+        for n, p in self.model.named_parameters():
+            if pp_outer is not None and n not in pp_outer:
+                continue
+            spec = param_pspec(p, stage)
+            targets[f"model/{n}"] = NamedSharding(mesh, spec)
+            targets[f"optimizer/{n}"] = _slot_target(spec)
+        repl = NamedSharding(mesh, P())
+        for n, _ in self.model.named_buffers():
+            targets[f"model/{n}"] = repl
+        return targets
 
     # ------------------------------------------------------- multi-process
     def _globalize_batch(self, batch_arrays):
@@ -732,6 +772,10 @@ class DistributedTrainStep:
             b._array if isinstance(b, Tensor) else jnp.asarray(b)
             for b in batch)
         from ..resilience import chaos as _chaos
+        # chaos site: the whole fleet is killed for an elastic restart —
+        # the harness restarts on a different world size and the retained
+        # checkpoint reshards onto the new mesh (chaos_check --mesh-change)
+        _chaos.crash("restart.mesh_change")
         if self._jitted is None:
             # chaos site: a compile failure must surface once and succeed
             # on retry (_jitted stays None, the next call rebuilds)

@@ -51,42 +51,77 @@ def _parse_args(argv):
                         "nothing); 0 disables")
     p.add_argument("--crash_loop_window", type=float, default=60.0,
                    help="crash-loop detection window in seconds")
+    p.add_argument("--heartbeat_timeout", type=float, default=0.0,
+                   help="kill + restart a worker whose heartbeat file "
+                        "goes stale for this many seconds (distinguishes "
+                        "a HUNG worker from a crashed one; 0 disables). "
+                        "Workers beat via distributed.init_parallel_env "
+                        "or launch.heartbeat.start_heartbeat")
+    p.add_argument("--heartbeat_interval", type=float, default=1.0,
+                   help="seconds between worker heartbeats (exported as "
+                        "PT_HEARTBEAT_INTERVAL)")
+    p.add_argument("--elastic", action="store_true",
+                   help="when a worker exhausts its restart budget, "
+                        "re-render the mesh spec for the surviving world "
+                        "size and restart the remaining workers instead "
+                        "of aborting (the resized mesh resumes from the "
+                        "retained checkpoint via resilience.reshard)")
     p.add_argument("--devices", default=None,
                    help="accepted for reference compat (unused on TPU)")
     p.add_argument("script", help="training script")
     p.add_argument("script_args", nargs="...",
                    help="arguments passed through to the script")
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.elastic and args.nnodes > 1:
+        # each host runs its own supervisor; a per-node downsize would
+        # re-render PT_NUM_PROCESSES / rank numbering on this node only,
+        # handing jax.distributed.initialize conflicting world specs
+        p.error("--elastic requires --nnodes=1: supervisors do not "
+                "coordinate a downsize across hosts")
+    return args
 
 
-def _worker_env(args, local_rank, restarts=0):
+def _worker_env(args, local_rank, restarts=0, world=None, hb_path=None):
+    """Per-rank environment — the rendered "mesh spec" each worker reads
+    (PT_NUM_PROCESSES/PT_PROCESS_ID feed jax.distributed.initialize via
+    init_parallel_env).  `world` overrides the spec on an elastic
+    downsize: the surviving workers restart seeing the smaller world."""
     env = dict(os.environ)
-    world = args.nnodes * args.nproc_per_node
-    rank = args.node_rank * args.nproc_per_node + local_rank
+    nproc = world if world is not None else args.nproc_per_node
+    world_total = args.nnodes * nproc
+    rank = args.node_rank * nproc + local_rank
     env["PT_COORDINATOR"] = args.master
-    env["PT_NUM_PROCESSES"] = str(world)
+    env["PT_NUM_PROCESSES"] = str(world_total)
     env["PT_PROCESS_ID"] = str(rank)
     env["PT_LOCAL_RANK"] = str(local_rank)
     # restart ordinal: lets the script know it is a recovery attempt
     # (resilience.manager.restart_count() reads this to e.g. prefer
     # checkpoint fallback over strict resume)
     env["PT_RESTART_COUNT"] = str(restarts)
+    if hb_path:
+        env["PT_HEARTBEAT_FILE"] = hb_path
+        env["PT_HEARTBEAT_INTERVAL"] = str(args.heartbeat_interval)
     # reference-compatible aliases user scripts may read
     env["PADDLE_TRAINER_ID"] = str(rank)
-    env["PADDLE_TRAINERS_NUM"] = str(world)
+    env["PADDLE_TRAINERS_NUM"] = str(world_total)
     return env
 
 
 class _Worker:
-    def __init__(self, args, local_rank):
+    def __init__(self, args, local_rank, hb_dir=None):
         self.args = args
         self.local_rank = local_rank
         self.restarts = 0
         self.restart_at = 0.0   # monotonic deadline of a pending restart
+        self.started_at = 0.0
+        self._hb_mtime = None   # last observed heartbeat-file mtime
+        self._hb_seen_at = 0.0  # monotonic time that mtime was observed
         self.proc = None
         self.log = None
+        self.hb_path = (os.path.join(hb_dir, f"hb.{local_rank}")
+                        if hb_dir else None)
 
-    def start(self):
+    def start(self, world=None):
         cmd = [sys.executable, self.args.script] + self.args.script_args
         stdout = stderr = None
         if self.args.log_dir:
@@ -98,13 +133,45 @@ class _Worker:
             self.log = open(os.path.join(self.args.log_dir,
                                          f"worker.{rank}.log"), "ab")
             stdout = stderr = self.log
+        if self.hb_path and os.path.exists(self.hb_path):
+            os.unlink(self.hb_path)   # stale mtime from the last life
         self.proc = subprocess.Popen(
             cmd, env=_worker_env(self.args, self.local_rank,
-                                 restarts=self.restarts),
+                                 restarts=self.restarts, world=world,
+                                 hb_path=self.hb_path),
             stdout=stdout, stderr=stderr)
+        self.started_at = time.monotonic()
+        self._hb_mtime = None
+        self._hb_seen_at = self.started_at
 
     def poll(self):
         return self.proc.poll()
+
+    def heartbeat_stale(self, timeout, now):
+        """True when this worker is beating but went silent past
+        `timeout` — a hang, not a crash (no-file workers never report
+        stale: the script may simply not emit heartbeats).  The mtime is
+        used only as a change detector; staleness itself is measured on
+        the supervisor's monotonic clock, so a wall-clock step (NTP)
+        cannot declare the whole fleet hung at once."""
+        if not self.hb_path or self.proc is None or \
+                self.proc.poll() is not None:
+            return False
+        try:
+            mtime = os.path.getmtime(self.hb_path)
+        except OSError:
+            return False   # never beat: not participating
+        if mtime != self._hb_mtime:   # fresh beat observed
+            self._hb_mtime = mtime
+            self._hb_seen_at = now
+            return False
+        return now - self._hb_seen_at > timeout and \
+            now - self.started_at > timeout
+
+    def kill(self):
+        if self.proc and self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait()
 
     def terminate(self):
         if self.proc and self.proc.poll() is None:
@@ -113,15 +180,25 @@ class _Worker:
                 self.proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 self.proc.kill()
+                self.proc.wait()   # reap: the old process must be gone
+                                   # before an elastic respawn reuses its
+                                   # rank/heartbeat file/coordinator port
         if self.log:
             self.log.close()
             self.log = None
 
 
 def run(argv=None):
+    import tempfile
     from ...resilience.backoff import Backoff, CrashLoopDetector
     args = _parse_args(sys.argv[1:] if argv is None else argv)
-    workers = [_Worker(args, lr) for lr in range(args.nproc_per_node)]
+    hb_dir = None
+    if args.heartbeat_timeout > 0:
+        hb_dir = args.log_dir or tempfile.mkdtemp(prefix="pt_launch_hb_")
+        os.makedirs(hb_dir, exist_ok=True)
+    workers = [_Worker(args, lr, hb_dir=hb_dir)
+               for lr in range(args.nproc_per_node)]
+    world = None          # None = the spec as parsed; set on downsize
     backoff = Backoff(base=args.restart_backoff,
                       max_delay=args.restart_backoff_max)
     # one detector across all local workers: a deterministic failure
@@ -130,7 +207,7 @@ def run(argv=None):
     detector = CrashLoopDetector(threshold=args.crash_loop_threshold,
                                  window=args.crash_loop_window)
     for w in workers:
-        w.start()
+        w.start(world=world)
     try:
         while True:
             running = False
@@ -139,8 +216,18 @@ def run(argv=None):
                 if w.proc is None:       # restart pending its backoff
                     running = True
                     if now >= w.restart_at:
-                        w.start()
+                        w.start(world=world)
                     continue
+                if args.heartbeat_timeout > 0 and \
+                        w.heartbeat_stale(args.heartbeat_timeout, now):
+                    # no exit code but no liveness either: a HANG (wedged
+                    # collective), not a crash — kill it ourselves so the
+                    # restart path below gets its exit code
+                    print(f"[launch] worker {w.local_rank} heartbeat "
+                          f"stale > {args.heartbeat_timeout:.1f}s — "
+                          f"hung, not crashed; killing for restart",
+                          file=sys.stderr)
+                    w.kill()
                 code = w.poll()
                 if code is None:
                     running = True
@@ -169,6 +256,34 @@ def run(argv=None):
                         w.proc = None
                         w.restart_at = now + delay
                         running = True
+                    elif args.elastic and len(workers) > 1:
+                        # elastic downsize: this rank is gone for good —
+                        # re-render the mesh spec for the surviving
+                        # world size and restart the survivors into it
+                        # (they resume from the retained checkpoint,
+                        # resharded by resilience.reshard)
+                        workers.remove(w)
+                        if w.log:
+                            w.log.close()
+                            w.log = None
+                        world = len(workers)
+                        print(f"[launch] worker {w.local_rank} failed "
+                              f"with code {code}, restart budget "
+                              f"exhausted; elastic downsize — "
+                              f"re-rendering mesh spec for world "
+                              f"{world} (was {world + 1})",
+                              file=sys.stderr)
+                        for i, o in enumerate(workers):
+                            o.terminate()
+                            o.local_rank = i
+                            if o.hb_path:
+                                o.hb_path = os.path.join(hb_dir,
+                                                         f"hb.{i}")
+                            o.restarts += 1   # a recovery attempt:
+                            o.proc = None     # PT_RESTART_COUNT bumps
+                            o.restart_at = now
+                        running = True
+                        break   # workers mutated: restart the scan
                     else:
                         print(f"[launch] worker {w.local_rank} failed "
                               f"with code {code}; stopping all",

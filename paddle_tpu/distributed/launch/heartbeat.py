@@ -1,0 +1,76 @@
+"""Worker-side heartbeat: the liveness signal the launch supervisor uses
+to tell a *hung* worker from a *crashed* one.
+
+A crashed worker has an exit code — the supervisor restarts it through
+the backoff policy.  A hung worker (deadlocked collective, wedged host
+callback) has no exit code and, without a liveness signal, wedges the
+whole fleet forever.  The launcher exports ``PT_HEARTBEAT_FILE`` /
+``PT_HEARTBEAT_INTERVAL`` to each worker; :func:`start_heartbeat` (auto-
+armed by ``distributed.init_parallel_env()``) touches that file from a
+daemon thread every interval.  The supervisor watches the file's mtime:
+stale beyond ``--heartbeat_timeout`` means hang → SIGKILL + restart,
+with the same backoff/crash-loop accounting as a crash.
+
+The thread is deliberately dumb — ``os.utime`` on an empty file, no
+sockets, no jax — so it keeps beating while the main thread is stuck
+inside an XLA program, which is exactly the failure it reports.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+_ACTIVE = None  # singleton: one beating thread per process
+
+
+class _Heartbeat:
+    def __init__(self, path, interval):
+        self.path = path
+        self.interval = float(interval)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="pt-heartbeat")
+
+    def _beat(self):
+        with open(self.path, "a"):
+            os.utime(self.path, None)
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self._beat()
+            except OSError:
+                pass    # a vanished log dir must not kill the worker
+
+    def start(self):
+        self._beat()   # first beat synchronously: the supervisor sees a
+        self._thread.start()   # live file before any interval elapses
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+
+def start_heartbeat(path=None, interval=None):
+    """Start (or return the already-running) heartbeat thread.  With no
+    arguments, reads PT_HEARTBEAT_FILE / PT_HEARTBEAT_INTERVAL from the
+    environment; returns None when neither names a file (not launched
+    under a heartbeat-watching supervisor)."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        return _ACTIVE
+    path = path or os.environ.get("PT_HEARTBEAT_FILE")
+    if not path:
+        return None
+    interval = interval if interval is not None else float(
+        os.environ.get("PT_HEARTBEAT_INTERVAL", "1.0"))
+    _ACTIVE = _Heartbeat(path, interval).start()
+    return _ACTIVE
+
+
+def stop_heartbeat():
+    global _ACTIVE
+    if _ACTIVE is not None:
+        _ACTIVE.stop()
+        _ACTIVE = None

@@ -20,6 +20,7 @@ import jax
 import numpy as np
 
 from ..resilience import chaos as _chaos
+from ..resilience import reshard as _reshard
 from ..tensor import Tensor
 from . import random as _random
 
@@ -52,17 +53,27 @@ def _unesc(k):
     return k.replace("╱", "/")
 
 
-def _split_state_dict(sd):
-    """Split a (possibly nested) state_dict into arrays vs json scalars."""
+def _split_state_dict(sd, layouts=None, prefix=()):
+    """Split a (possibly nested) state_dict into arrays vs json scalars.
+
+    When `layouts` is a dict, each array leaf that is live under a
+    NamedSharding records its portable :class:`resilience.reshard.Layout`
+    keyed by the unescaped tree path (``model/linear.weight``) — the
+    save-time half of cross-mesh checkpoint resharding."""
     arrays, meta = {}, {}
     for k, v in sd.items():
-        k = _esc(str(k))
-        if isinstance(v, Tensor):
-            arrays[k] = np.asarray(v._array)
-        elif isinstance(v, (jax.Array, np.ndarray)):
-            arrays[k] = np.asarray(v)
+        name = str(k)
+        k = _esc(name)
+        if isinstance(v, (Tensor, jax.Array, np.ndarray)):
+            arr = v._array if isinstance(v, Tensor) else v
+            if layouts is not None:
+                lay = _reshard.layout_of(arr)
+                if lay is not None:
+                    layouts["/".join(prefix + (name,))] = lay.to_json()
+            arrays[k] = np.asarray(arr)
         elif isinstance(v, dict):
-            a, m = _split_state_dict(v)
+            a, m = _split_state_dict(v, layouts=layouts,
+                                     prefix=prefix + (name,))
             if a:
                 arrays[k] = a
             if m:
@@ -101,18 +112,24 @@ def save_state(path, model=None, optimizer=None, scaler=None, step=0,
     """
     path = os.path.abspath(path)
     os.makedirs(path, exist_ok=True)
-    arrays, meta = {}, {"step": int(step)}
+    arrays, meta, layouts = {}, {"step": int(step)}, {}
     if model is not None:
-        a, m = _split_state_dict(dict(model.state_dict()))
+        a, m = _split_state_dict(dict(model.state_dict()),
+                                 layouts=layouts, prefix=("model",))
         arrays["model"] = a
         if m:
             meta["model"] = m
     if optimizer is not None:
-        a, m = _split_state_dict(optimizer.state_dict())
+        a, m = _split_state_dict(optimizer.state_dict(),
+                                 layouts=layouts, prefix=("optimizer",))
         if a:
             arrays["optimizer"] = a
         if m:
             meta["optimizer"] = m
+    if layouts:
+        # how each array was sharded at save time — the source half of a
+        # cross-mesh restore's redistribution plan (arXiv:2112.01075)
+        meta["layouts"] = layouts
     if scaler is not None:
         meta["scaler"] = scaler.state_dict()
     rng = _random.get_rng_state()
@@ -199,7 +216,26 @@ def probe(path):
     return meta
 
 
-def load_state(path, model=None, optimizer=None, scaler=None):
+def _apply_resharder(tree, resharder, prefix=()):
+    """Route array leaves with a known target sharding through the
+    device-side reshard path (each device receives only its target
+    shard); leaves without a target keep the legacy host value.
+    Top-level bookkeeping leaves (commit_token, rng_key) are never
+    resharded."""
+    out = {}
+    for k, v in tree.items():
+        name = _unesc(k)
+        if isinstance(v, dict):
+            out[k] = _apply_resharder(v, resharder, prefix + (name,))
+        else:
+            placed = resharder.maybe_place(
+                "/".join(prefix + (name,)), v) if prefix else None
+            out[k] = v if placed is None else placed
+    return out
+
+
+def load_state(path, model=None, optimizer=None, scaler=None,
+               resharder=None, meta=None):
     """Restore state saved by `save_state` in place; returns the meta dict
     (step, extra, ...).
 
@@ -207,9 +243,19 @@ def load_state(path, model=None, optimizer=None, scaler=None):
     (arrays vs meta) on partial/empty/torn checkpoints, so a manager-level
     fallback can catch precisely what it can recover from.  Validation
     happens BEFORE any model/optimizer mutation.
+
+    `resharder` (a :class:`resilience.reshard.Resharder`, normally built
+    by ``CheckpointManager.restore`` on a mesh mismatch) redirects array
+    leaves with known target shardings onto the current mesh device-side
+    — the bounded-memory alternative to replicating every host array.
+
+    `meta` short-circuits the probe when the caller already holds the
+    parsed meta dict for this path (the manager probes each candidate
+    before planning a reshard; re-reading it here would double the I/O).
     """
     path = os.path.abspath(path)
-    meta = probe(path)
+    if meta is None:
+        meta = probe(path)
     orphan_tmp = os.path.exists(os.path.join(path, _META) + ".tmp")
     arrays_path = os.path.join(path, _ARRAYS)
     ckptr = _checkpointer()
@@ -230,6 +276,8 @@ def load_state(path, model=None, optimizer=None, scaler=None):
                 "; an orphaned meta.json.tmp is present from the "
                 "interrupted save" if orphan_tmp else ""),
             path=path)
+    if resharder is not None:
+        arrays = _apply_resharder(arrays, resharder)
     if model is not None and "model" in arrays:
         sd = _merge_state_dict(arrays["model"], meta.get("model"))
         model.set_state_dict(sd)

@@ -1,6 +1,6 @@
 """paddle_tpu.resilience — fault injection + recovery for production runs.
 
-Four pieces, each observable through the telemetry registry:
+Five pieces, each observable through the telemetry registry:
 
   chaos     deterministic fault-injection harness (seeded plans /
             PADDLE_TPU_CHAOS) firing at named sites across the stack
@@ -10,6 +10,9 @@ Four pieces, each observable through the telemetry registry:
   manager   CheckpointManager: step-numbered retention + GC, torn-
             checkpoint fallback, SIGTERM preemption flush, mesh-aware
             restore across world-size changes
+  reshard   cross-mesh checkpoint redistribution: the allgather /
+            dynamic-slice / all-to-all decomposition of arXiv:2112.01075
+            executed device-side in bounded memory on elastic restarts
   backoff   shared restart policy (exponential backoff + crash-loop
             detection) used by distributed/launch and io/shm_loader
 
@@ -24,20 +27,24 @@ from .chaos import ChaosInterrupt, ChaosPlan  # noqa: F401
 
 chaos.plan_from_env()   # honor PADDLE_TPU_CHAOS=<spec> from process env
 
-__all__ = ["chaos", "backoff", "guard", "manager", "ChaosPlan",
-           "ChaosInterrupt", "Backoff", "CrashLoopDetector",
-           "NonfiniteGuard", "CheckpointManager", "CheckpointError"]
+__all__ = ["chaos", "backoff", "guard", "manager", "reshard",
+           "ChaosPlan", "ChaosInterrupt", "Backoff", "CrashLoopDetector",
+           "NonfiniteGuard", "CheckpointManager", "CheckpointError",
+           "Resharder", "ReshardPlan"]
 
 _LAZY = {
     # guard/manager import jax / framework.checkpoint; loading them here
     # eagerly would cycle (framework.checkpoint imports resilience.chaos)
     "guard": ("paddle_tpu.resilience.guard", None),
     "manager": ("paddle_tpu.resilience.manager", None),
+    "reshard": ("paddle_tpu.resilience.reshard", None),
     "NonfiniteGuard": ("paddle_tpu.resilience.guard", "NonfiniteGuard"),
     "CheckpointManager": ("paddle_tpu.resilience.manager",
                           "CheckpointManager"),
     "CheckpointError": ("paddle_tpu.framework.checkpoint",
                         "CheckpointError"),
+    "Resharder": ("paddle_tpu.resilience.reshard", "Resharder"),
+    "ReshardPlan": ("paddle_tpu.resilience.reshard", "ReshardPlan"),
 }
 
 

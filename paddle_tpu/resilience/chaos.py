@@ -31,6 +31,12 @@ Fault sites (see docs/resilience.md for the full table):
     step.nonfinite              poison the batch → nonfinite loss/grads
     compile.fail_once           raise inside the jit build
     collective.fail_once        raise inside an eager collective
+    collective.timeout          an eager collective hits its deadline
+                                (CollectiveTimeout → policy retry path)
+    collective.hang             an eager collective stalls past the
+                                watchdog deadline (abandoned + retried)
+    restart.mesh_change         kill the fleet step for an elastic
+                                restart onto a different world size
     ckpt.crash_after_meta_stage crash save: meta staged, arrays old
     ckpt.crash_after_arrays     crash save: arrays committed, meta old
     save.sigterm                SIGTERM this process mid-save_state

@@ -14,15 +14,18 @@ Preemption: ``install_preemption_handler()`` turns SIGTERM into a
 loop (`hapi.callbacks.ResilienceCallback`) sees the flag, writes one
 final checkpoint, and stops cleanly instead of dying mid-epoch.
 
-Mesh-aware restore: every save records the live fleet mesh axes and the
-process/device world size.  When a restart resumes on a *different*
-topology (elastic restart after losing a host), the manager detects the
-mismatch, counts it into telemetry, and restores anyway: arrays are
-persisted as host-gathered (unsharded) numpy, and the fleet engine
-re-places them under the *current* mesh's shardings on the next step —
-the host-bounce instance of portable array redistribution
-(arXiv:2112.01075); an in-HBM collective-permute repath is the planned
-fast path for same-size remaps.
+Mesh-aware restore: every save records the live fleet mesh axes, the
+process/device world size, and each array's sharding layout.  When a
+restart resumes on a *different* topology (elastic restart after losing
+a host), the manager detects the mismatch and — when the attached train
+step can name its target shardings (`restore_shardings()`) — routes the
+arrays through `resilience.reshard`: the portable allgather /
+dynamic-slice / all-to-all redistribution of arXiv:2112.01075, executed
+device-side in bounded memory (each device receives only its target
+shard; the full array is never replicated).  Arrays without a known
+target, pre-resilience checkpoints with no mesh snapshot, and pp-stacked
+optimizer state keep the legacy host-gather path, counted separately
+(``resilience_mesh_reshard_total{path=device|host_fallback}``).
 """
 from __future__ import annotations
 
@@ -221,9 +224,13 @@ class CheckpointManager:
         for step in reversed(steps):
             path = self.path_for(step)
             try:
-                self.verify(path)
+                meta_light = _ckpt.probe(path)
+                resharder, mesh_changed = self._plan_restore(
+                    meta_light, train_step)
                 meta = _ckpt.load_state(path, model=model,
-                                        optimizer=optimizer, scaler=scaler)
+                                        optimizer=optimizer, scaler=scaler,
+                                        resharder=resharder,
+                                        meta=meta_light)
             except err as e:
                 last_exc = e
                 _registry().counter(
@@ -232,7 +239,7 @@ class CheckpointManager:
                     f"checkpoint fallback: {e}; trying the previous "
                     f"consistent checkpoint", RuntimeWarning)
                 continue
-            self._after_restore(meta, train_step)
+            self._after_restore(meta, train_step, resharder, mesh_changed)
             meta["__path__"] = path
             _registry().counter("resilience_ckpt_restores_total").inc()
             return meta
@@ -242,19 +249,73 @@ class CheckpointManager:
             (f"; last error: {last_exc}" if last_exc else ""),
             path=self.root)
 
-    def _after_restore(self, meta, train_step):
+    def _plan_restore(self, meta_light, train_step):
+        """Decide the restore route before any array is read: on a mesh
+        mismatch, arrays whose target shardings the attached train step
+        can name (`restore_shardings()`) go through the device-side
+        reshard path (resilience.reshard, arXiv:2112.01075); everything
+        else keeps the legacy host-gather bounce.  Pre-resilience
+        checkpoints without a mesh snapshot are treated as "unknown
+        mesh" and restore via the legacy path with a one-time warning.
+        Returns (resharder_or_None, mesh_changed)."""
+        extra = (meta_light.get("extra") or {})
+        saved_mesh = extra.get("mesh") or {}
+        if not saved_mesh:
+            if not getattr(self, "_warned_no_mesh", False):
+                self._warned_no_mesh = True
+                warnings.warn(
+                    "checkpoint meta has no mesh snapshot (pre-resilience "
+                    "format): treating the saving mesh as unknown and "
+                    "restoring via the legacy host-gather path",
+                    RuntimeWarning)
+            return None, False
+        if saved_mesh == _mesh_info():
+            return None, False
+        targets = None
+        fn = getattr(train_step, "restore_shardings", None)
+        if fn is not None:
+            try:
+                targets = fn()
+            except Exception as e:
+                warnings.warn(
+                    f"restore_shardings() failed ({e}); falling back to "
+                    f"the host-gather restore path", RuntimeWarning)
+        if not targets:
+            return None, True
+        from . import reshard as _reshard
+        return _reshard.Resharder(
+            targets, layouts=meta_light.get("layouts")), True
+
+    def _after_restore(self, meta, train_step, resharder=None,
+                       mesh_changed=False):
         saved_mesh = (meta.get("extra") or {}).get("mesh") or {}
         cur_mesh = _mesh_info()
-        if saved_mesh and saved_mesh != cur_mesh:
-            # world size / axis degrees changed across the restart: the
-            # host-gathered arrays reshard onto the current mesh when the
-            # engine re-places them (portable redistribution through the
-            # host, arXiv:2112.01075)
-            _registry().counter("resilience_mesh_reshard_total").inc()
-            warnings.warn(
-                f"resuming on a different mesh: checkpoint saved under "
-                f"{saved_mesh}, restoring under {cur_mesh}; host arrays "
-                f"reshard on next placement", RuntimeWarning)
+        if mesh_changed or (saved_mesh and saved_mesh != cur_mesh):
+            # world size / axis degrees changed across the restart
+            # (elastic restart): count the event, labeled by which route
+            # actually moved the arrays
+            reg = _registry()
+            reg.counter("resilience_mesh_reshard_total").inc()
+            device_path = resharder is not None and resharder.arrays > 0
+            reg.counter("resilience_mesh_reshard_total",
+                        path="device" if device_path
+                        else "host_fallback").inc()
+            if device_path:
+                reg.counter("reshard_restore_bytes_total").inc(
+                    resharder.bytes_moved)
+                warnings.warn(
+                    f"resuming on a different mesh: checkpoint saved "
+                    f"under {saved_mesh}, restoring under {cur_mesh}; "
+                    f"{resharder.arrays} arrays redistributed device-"
+                    f"side (~{resharder.bytes_moved} B moved, peak "
+                    f"{resharder.peak_buffer_bytes} B/device)",
+                    RuntimeWarning)
+            else:
+                warnings.warn(
+                    f"resuming on a different mesh: checkpoint saved "
+                    f"under {saved_mesh}, restoring under {cur_mesh}; "
+                    f"host arrays reshard on next placement",
+                    RuntimeWarning)
         if train_step is not None and hasattr(train_step, "reload_from"):
             train_step.reload_from(step=meta.get("step"))
 

@@ -625,3 +625,31 @@ def test_chaos_check_subprocess():
         capture_output=True, text=True,
         env=dict(os.environ, JAX_PLATFORMS="cpu"))
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_chaos_check_mesh_change_inprocess():
+    """The elastic restart drill: a run killed on a 4-device mesh resumes
+    on a 2-device mesh via device-side resharding (no replicated host
+    bounce), its post-restore loss trajectory matches the uninterrupted
+    reference, and an injected collective.timeout is retried by the
+    collective policy without supervisor intervention."""
+    import io
+    from paddle_tpu.distributed import mesh as mesh_mod
+    prev = dict(mesh_mod._state)
+    buf = io.StringIO()
+    try:
+        rc = _load_chaos_check().run_mesh_change(out=buf)
+    finally:
+        mesh_mod._state.update(prev)
+    assert rc == 0, buf.getvalue()
+    assert "resumed on dp=2 via device-side resharding" in buf.getvalue()
+
+
+@pytest.mark.slow
+def test_chaos_check_mesh_change_subprocess():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_check.py"),
+         "--mesh-change"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -1,0 +1,350 @@
+"""Elastic recovery engine: cross-mesh resharding + collective robustness.
+
+Covers: the arXiv:2112.01075 plan decomposition (shrink -> allgather,
+grow -> dynamic-slice, axis permutation -> all-to-all), bit-exactness of
+save-under-mesh-A -> reshard -> restore-under-mesh-B against the
+host-gather reference, the checkpoint-level Resharder path, the
+collective timeout/retry policy driven through the collective.timeout /
+collective.hang chaos sites, the launch heartbeat, and back-compat with
+pre-resilience checkpoints that carry no mesh snapshot.
+"""
+import os
+import tempfile
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import collective as coll
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.framework.checkpoint import load_state, probe, save_state
+from paddle_tpu.observability import metrics
+from paddle_tpu.resilience import chaos
+from paddle_tpu.resilience.manager import CheckpointManager
+from paddle_tpu.resilience.reshard import (
+    Layout, Resharder, layout_of, place_from_host, plan_reshard,
+    reshard_array)
+
+
+@pytest.fixture(autouse=True)
+def _clean_runtime():
+    prev = dict(mesh_mod._state)
+    yield
+    mesh_mod._state.update(prev)
+    chaos.uninstall()
+    coll.configure_collectives()
+
+
+def _mesh(n, axes=("dp",), shape=None):
+    devs = np.asarray(jax.devices()[:n])
+    if shape is not None:
+        devs = devs.reshape(shape)
+    return Mesh(devs, axes)
+
+
+def _sharded(shape, mesh, spec, seed=0):
+    host = np.random.RandomState(seed).randn(*shape).astype(np.float32)
+    return host, jax.device_put(host, NamedSharding(mesh, spec))
+
+
+def _assert_matches_host(out, host, dst_sharding):
+    """Bit-exact vs the host-gather reference, shard by shard and as a
+    whole."""
+    assert out.sharding == dst_sharding
+    np.testing.assert_array_equal(np.asarray(out), host)
+    for s in out.addressable_shards:
+        np.testing.assert_array_equal(np.asarray(s.data), host[s.index])
+
+
+# ===================================================================
+# plan decomposition (arXiv:2112.01075)
+# ===================================================================
+def test_plan_shrink_classifies_allgather():
+    src = Layout([("dp",)], {"dp": 4})
+    dst = NamedSharding(_mesh(2), P("dp"))
+    plan = plan_reshard((8, 4), np.float32, src, dst)
+    kinds = [k for k, _, _ in plan.ops]
+    assert "allgather" in kinds
+    assert plan.mesh_changed
+    assert plan.bytes_moved > 0
+    # shrink 4 -> 2: peak per-device buffer is the COARSER (target) shard
+    assert plan.peak_buffer_bytes == 8 * 4 * 4 // 2
+
+
+def test_plan_grow_classifies_slice():
+    src = Layout([("dp",)], {"dp": 2})
+    dst = NamedSharding(_mesh(4), P("dp"))
+    plan = plan_reshard((8, 4), np.float32, src, dst)
+    kinds = [k for k, _, _ in plan.ops]
+    assert "slice" in kinds and "allgather" not in kinds
+    # grow 2 -> 4: nothing coarser than the source shard is materialized
+    assert plan.peak_buffer_bytes == 8 * 4 * 4 // 2
+
+
+def test_plan_axis_permutation_classifies_all_to_all():
+    mesh = _mesh(4, axes=("x", "y"), shape=(2, 2))
+    src = Layout([("x",), ("y",)], {"x": 2, "y": 2})
+    dst = NamedSharding(mesh, P("y", "x"))
+    plan = plan_reshard((4, 4), np.float32, src, dst)
+    assert [k for k, _, _ in plan.ops] == ["all_to_all"]
+    assert not plan.mesh_changed
+
+
+def test_plan_unknown_source_is_mesh_change():
+    dst = NamedSharding(_mesh(2), P("dp"))
+    plan = plan_reshard((8, 4), np.float32, None, dst)
+    assert plan.mesh_changed
+    assert plan.bytes_moved >= 8 * 4 * 4   # full payload relocates
+
+
+# ===================================================================
+# save-under-A -> reshard -> restore-under-B, bit-exact vs host-gather
+# ===================================================================
+@pytest.mark.parametrize("n_src,n_dst", [(4, 2),   # shrink
+                                         (2, 4)])  # grow
+def test_place_from_host_world_resize_bit_exact(n_src, n_dst):
+    mesh_a = _mesh(n_src)
+    host, arr = _sharded((8, 4), mesh_a, P("dp"), seed=n_src)
+    src = layout_of(arr)
+    assert src is not None and src.axes == {"dp": n_src}
+    dst = NamedSharding(_mesh(n_dst), P("dp"))
+    out = place_from_host(np.asarray(arr), dst, src=src)
+    _assert_matches_host(out, host, dst)
+
+
+def test_place_from_host_axis_permutation_bit_exact():
+    mesh = _mesh(4, axes=("x", "y"), shape=(2, 2))
+    host, arr = _sharded((4, 6), mesh, P("x", "y"), seed=3)
+    dst = NamedSharding(mesh, P("y", "x"))
+    out = place_from_host(np.asarray(arr), dst, src=layout_of(arr))
+    _assert_matches_host(out, host, dst)
+
+
+@pytest.mark.parametrize("n_src,n_dst", [(4, 2), (2, 4)])
+def test_reshard_array_live_world_resize_bit_exact(n_src, n_dst):
+    mesh_a = _mesh(n_src)
+    host, arr = _sharded((8, 4), mesh_a, P("dp"), seed=10 + n_src)
+    dst = NamedSharding(_mesh(n_dst), P("dp"))
+    out = reshard_array(arr, dst)
+    _assert_matches_host(out, host, dst)
+
+
+def test_reshard_array_same_sharding_is_identity():
+    mesh = _mesh(2)
+    _, arr = _sharded((4, 4), mesh, P("dp"))
+    assert reshard_array(arr, arr.sharding) is arr
+
+
+# ===================================================================
+# checkpoint-level Resharder (framework.checkpoint.load_state route)
+# ===================================================================
+def test_checkpoint_resharder_routes_device_path(tmp_path):
+    mesh_a = _mesh(4)
+    paddle.seed(5)
+    model = nn.Linear(4, 2)
+    w_host = np.asarray(model.weight.numpy()).copy()
+    b_host = np.asarray(model.bias.numpy()).copy()
+    model.weight._inplace_assign(
+        jax.device_put(model.weight._array, NamedSharding(mesh_a, P("dp"))))
+    path = str(tmp_path / "ckpt")
+    save_state(path, model=model, step=1)
+    meta = probe(path)
+    # save-time layouts recorded for the sharded leaf
+    assert "model/weight" in meta.get("layouts", {})
+    assert Layout.from_json(meta["layouts"]["model/weight"]).axes == \
+        {"dp": 4}
+
+    mesh_b = _mesh(2)
+    paddle.seed(99)                       # values must come from the ckpt
+    model2 = nn.Linear(4, 2)
+    rs = Resharder({"model/weight": NamedSharding(mesh_b, P("dp")),
+                    "model/bias": NamedSharding(mesh_b, P())},
+                   layouts=meta.get("layouts"))
+    load_state(path, model=model2, resharder=rs)
+    assert rs.arrays == 2 and rs.skipped == 0
+    np.testing.assert_array_equal(np.asarray(model2.weight.numpy()), w_host)
+    np.testing.assert_array_equal(np.asarray(model2.bias.numpy()), b_host)
+
+
+def test_resharder_unknown_path_falls_through():
+    rs = Resharder({"model/weight": NamedSharding(_mesh(2), P("dp"))})
+    assert rs.maybe_place("model/other", np.ones((4,), np.float32)) is None
+    assert rs.skipped == 1
+
+
+def test_resharder_parent_prefix_covers_slots():
+    mesh = _mesh(2)
+    rs = Resharder({"optimizer/w": lambda shape: NamedSharding(mesh, P())})
+    out = rs.maybe_place("optimizer/w/velocity",
+                         np.ones((4, 2), np.float32))
+    assert out is not None and rs.arrays == 1
+
+
+# ===================================================================
+# back-compat: pre-resilience checkpoints without a mesh snapshot
+# ===================================================================
+def test_restore_tolerates_checkpoint_without_mesh_snapshot(tmp_path):
+    paddle.seed(6)
+    model = nn.Linear(4, 2)
+    w = np.asarray(model.weight.numpy()).copy()
+    root = str(tmp_path)
+    # write the checkpoint with save_state directly: no manager, so no
+    # "mesh" key in extra — the pre-PR-5 on-disk format
+    mgr = CheckpointManager(root)
+    save_state(mgr.path_for(3), model=model, step=3)
+    assert "mesh" not in (probe(mgr.path_for(3)).get("extra") or {})
+
+    paddle.seed(77)
+    model2 = nn.Linear(4, 2)
+    with warnings.catch_warnings(record=True) as ws:
+        warnings.simplefilter("always")
+        meta = mgr.restore(model=model2)
+    assert meta["step"] == 3
+    np.testing.assert_array_equal(np.asarray(model2.weight.numpy()), w)
+    msgs = [str(x.message) for x in ws
+            if "no mesh snapshot" in str(x.message)]
+    assert len(msgs) == 1
+    # one-time: a second restore through the same manager stays quiet
+    with warnings.catch_warnings(record=True) as ws2:
+        warnings.simplefilter("always")
+        mgr.restore(model=model2)
+    assert not [x for x in ws2 if "no mesh snapshot" in str(x.message)]
+
+
+# ===================================================================
+# collective timeout/retry policy through the chaos sites
+# ===================================================================
+def _retry_counts(op="all_reduce"):
+    reg = metrics.registry()
+    return (reg.counter("collective_timeout_total", op=op).value,
+            reg.counter("collective_retry_total", op=op).value)
+
+
+def test_collective_timeout_retried_by_policy():
+    coll.configure_collectives(timeout=30.0, retries=2, backoff_base=0.01)
+    t0, r0 = _retry_counts()
+    x = paddle.to_tensor(np.ones((4,), np.float32))
+    with chaos.scoped("collective.timeout@1"):
+        with warnings.catch_warnings(record=True) as ws:
+            warnings.simplefilter("always")
+            out = coll.all_reduce(x)
+    np.testing.assert_array_equal(np.asarray(out.numpy()), np.ones((4,)))
+    t1, r1 = _retry_counts()
+    assert t1 - t0 == 1 and r1 - r0 == 1
+    # the straggler warning names the mesh axis
+    assert any("straggler" in str(x.message) and "axis" in str(x.message)
+               for x in ws)
+
+
+def test_collective_timeout_exhausted_raises():
+    coll.configure_collectives(timeout=30.0, retries=1, backoff_base=0.01)
+    x = paddle.to_tensor(np.ones((4,), np.float32))
+    with chaos.scoped("collective.timeout@1*5"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with pytest.raises(coll.CollectiveTimeout):
+                coll.all_reduce(x)
+
+
+def test_collective_hang_abandoned_by_watchdog():
+    """A real stall (not an injected exception): the attempt thread
+    sleeps past the deadline, the watchdog abandons it, the retry
+    succeeds."""
+    coll.configure_collectives(timeout=0.2, retries=1, backoff_base=0.01)
+    t0, r0 = _retry_counts()
+    x = paddle.to_tensor(np.ones((4,), np.float32))
+    start = time.monotonic()
+    with chaos.scoped("collective.hang@1"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = coll.all_reduce(x)
+    assert time.monotonic() - start < 5.0   # abandoned, not slept out
+    np.testing.assert_array_equal(np.asarray(out.numpy()), np.ones((4,)))
+    t1, r1 = _retry_counts()
+    assert t1 - t0 == 1 and r1 - r0 == 1
+
+
+def test_collective_policy_all_defaults_clears():
+    coll.configure_collectives(timeout=5.0, retries=1)
+    assert coll.collective_policy() is not None
+    coll.configure_collectives()            # all-defaults clears
+    assert coll.collective_policy() is None
+
+
+def test_collective_fail_once_counted_and_retried():
+    coll.configure_collectives(retries=1, backoff_base=0.01)
+    reg = metrics.registry()
+    f0 = reg.counter("collective_failures_total", op="all_reduce").value
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    with chaos.scoped("collective.fail_once@1"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            coll.all_reduce(x)
+    assert reg.counter("collective_failures_total",
+                       op="all_reduce").value - f0 == 1
+
+
+# ===================================================================
+# launch heartbeat
+# ===================================================================
+def test_heartbeat_beats_and_stops(tmp_path):
+    from paddle_tpu.distributed.launch import heartbeat as hb
+    path = str(tmp_path / "hb.0")
+    try:
+        h = hb.start_heartbeat(path=path, interval=0.05)
+        assert h is not None and os.path.exists(path)
+        # backdate the file: the beating thread must refresh its mtime
+        os.utime(path, (time.time() - 60.0, time.time() - 60.0))
+        deadline = time.monotonic() + 5.0
+        while time.time() - os.path.getmtime(path) > 1.0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert time.time() - os.path.getmtime(path) <= 1.0
+        # second call returns the running singleton
+        assert hb.start_heartbeat(path=str(tmp_path / "other")) is h
+    finally:
+        hb.stop_heartbeat()
+    assert hb._ACTIVE is None
+
+
+def test_heartbeat_noop_without_env(monkeypatch):
+    from paddle_tpu.distributed.launch import heartbeat as hb
+    monkeypatch.delenv("PT_HEARTBEAT_FILE", raising=False)
+    assert hb.start_heartbeat() is None
+
+
+def test_worker_heartbeat_stale_detection(tmp_path):
+    from paddle_tpu.distributed.launch import _Worker
+
+    class _Args:
+        script, script_args, log_dir = "x.py", [], None
+        nnodes = node_rank = 1
+        nproc_per_node = 2
+
+    class _FakeProc:
+        def poll(self):
+            return None
+
+    w = _Worker(_Args(), 0, hb_dir=str(tmp_path))
+    w.proc = _FakeProc()
+    w.started_at = time.monotonic() - 60.0
+    now = time.monotonic()
+    # no heartbeat file ever written: not participating, never stale
+    assert not w.heartbeat_stale(1.0, now)
+    with open(w.hb_path, "w"):
+        pass
+    os.utime(w.hb_path, (time.time() - 30.0, time.time() - 30.0))
+    # mtime is only a change detector: the first observation arms the
+    # monotonic staleness clock (a wall-clock step / NTP jump must not
+    # declare the whole fleet hung at once)
+    assert not w.heartbeat_stale(1.0, now)
+    assert w.heartbeat_stale(1.0, now + 2.0)    # silent past timeout
+    os.utime(w.hb_path, None)
+    assert not w.heartbeat_stale(1.0, now + 2.0)   # fresh beat -> alive
+    assert w.heartbeat_stale(1.0, now + 4.0)    # silent again -> hang

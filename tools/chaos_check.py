@@ -20,7 +20,19 @@ uninterrupted reference run over the same batch schedule.  Any drift —
 a dropped batch, a half-applied optimizer step, a stale Momentum slot —
 fails the drill.
 
-Usage:  python tools/chaos_check.py [-v]
+``--mesh-change`` runs the **elastic restart drill** instead: train on a
+4-device dp mesh (ZeRO stage 3, params genuinely sharded) with retained
+checkpoints, kill the fleet via the ``restart.mesh_change`` chaos site,
+restart on a 2-device mesh and restore through the device-side reshard
+path (resilience.reshard, arXiv:2112.01075 — asserted via the
+``path=device`` counters, no replicated host bounce), then finish the
+run.  Along the resumed run an injected ``collective.timeout`` must be
+retried by the collective policy without supervisor intervention.  The
+post-restore loss trajectory must match the uninterrupted 4-device
+reference within ``MESH_TOL`` (dp=4 vs dp=2 only changes the reduction
+grouping of the same global batch).
+
+Usage:  python tools/chaos_check.py [-v] [--mesh-change]
 Exit 0 = all recovery paths green.
 """
 import argparse
@@ -227,10 +239,226 @@ def run(out=None, verbose=False):
     return 0
 
 
+# ======================================================== --mesh-change
+MESH_N_STEPS = 8    # optimizer steps in the elastic drill
+MESH_KILL_AT = 6    # restart.mesh_change fires on this fleet-step call
+MESH_SPEC = f"restart.mesh_change@{MESH_KILL_AT}"
+MESH_TOL = 1e-5     # dp=4 vs dp=2 reduction-grouping tolerance
+
+
+def _fleet_step(dp, stage=3, seed=1234):
+    """Fresh dp-mesh fleet engine (ZeRO `stage` so params are genuinely
+    sharded over dp and a world-size change is a real redistribution)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer as opt
+    from paddle_tpu.distributed import fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "sharding_stage": stage}
+    fleet.fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(seed)
+    model = nn.Linear(4, 2)
+    o = opt.Momentum(learning_rate=0.05, momentum=0.9,
+                     parameters=model.parameters())
+
+    def loss_fn(m, x, y):
+        return ((m(x) - y) ** 2).mean()
+
+    return model, fleet.fleet.build_train_step(model, loss_fn, o)
+
+
+def run_mesh_change(out=None, verbose=False):
+    """The elastic restart drill: 4-device train → chaos kill → 2-device
+    resume via device-side resharding → loss-trajectory continuity, plus
+    a retried collective.timeout along the resumed run."""
+    out = out if out is not None else sys.stdout
+    import shutil
+    import tempfile
+    import warnings
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.distributed import collective as coll
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.resilience.chaos import ChaosInterrupt
+    from paddle_tpu.resilience.manager import CheckpointManager
+
+    def log(msg):
+        if verbose:
+            print(msg, file=out)
+
+    import jax
+    if jax.device_count() < 4:
+        print(f"chaos_check --mesh-change needs >= 4 devices, have "
+              f"{jax.device_count()} (set XLA_FLAGS="
+              f"--xla_force_host_platform_device_count=8 before jax "
+              f"imports)", file=out)
+        return 1
+
+    reg = metrics.registry()
+
+    def counter_val(name, **labels):
+        return reg.counter(name, **labels).value
+
+    base_device = counter_val("resilience_mesh_reshard_total",
+                              path="device")
+    base_host = counter_val("resilience_mesh_reshard_total",
+                            path="host_fallback")
+    base_arrays = counter_val("reshard_arrays_total", path="device")
+    base_retry = counter_val("collective_retry_total", op="all_reduce")
+    base_tmo = counter_val("collective_timeout_total", op="all_reduce")
+
+    rs = np.random.RandomState(0)
+    batches = [(paddle.to_tensor(rs.randn(8, 4).astype("float32")),
+                paddle.to_tensor(rs.randn(8, 2).astype("float32")))
+               for _ in range(8)]
+
+    root = tempfile.mkdtemp(prefix="chaos_mesh_")
+    failures = []
+    try:
+        # ---- reference: uninterrupted run on the 4-device mesh --------
+        model_r, ts_r = _fleet_step(dp=4)
+        ref_losses = [float(ts_r(*batches[i % len(batches)]).numpy())
+                      for i in range(MESH_N_STEPS)]
+        ref_w = np.asarray(ts_r.model.weight.numpy()).copy()
+        log(f"reference (dp=4, uninterrupted): final loss "
+            f"{ref_losses[-1]:.6f}")
+
+        # ---- phase 1: train on dp=4, chaos kills the fleet -----------
+        model_c, ts_c = _fleet_step(dp=4)
+        mgr = CheckpointManager(root, max_to_keep=3)
+        plan = chaos.install(chaos.ChaosPlan(MESH_SPEC))
+        chaos_losses = {}
+        killed = False
+        try:
+            for i in range(MESH_N_STEPS):
+                chaos_losses[i] = float(
+                    ts_c(*batches[i % len(batches)]).numpy())
+                mgr.save(ts_c._step, train_step=ts_c)
+        except ChaosInterrupt:
+            killed = True
+        finally:
+            chaos.uninstall()
+        if not killed:
+            failures.append("restart.mesh_change never killed the fleet")
+        killed_at = max(chaos_losses, default=-1) + 1
+        log(f"phase 1 (dp=4): killed after step {killed_at}, "
+            f"latest ckpt {mgr.latest()}")
+
+        # ---- phase 2: restart on dp=2, reshard device-side -----------
+        # different init seed on purpose: every weight must come from
+        # the retained checkpoint, not from a lucky re-init
+        model_2, ts_2 = _fleet_step(dp=2, seed=999)
+        mgr2 = CheckpointManager(root, max_to_keep=3)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            meta = mgr2.restore(train_step=ts_2)
+        resumed = int(meta.get("step", -1))
+        if resumed != killed_at:
+            failures.append(
+                f"resume: restored step {resumed}, want {killed_at}")
+        d_device = counter_val("resilience_mesh_reshard_total",
+                               path="device") - base_device
+        d_host = counter_val("resilience_mesh_reshard_total",
+                             path="host_fallback") - base_host
+        d_arrays = counter_val("reshard_arrays_total",
+                               path="device") - base_arrays
+        if d_device != 1 or d_host != 0:
+            failures.append(
+                f"reshard route: resilience_mesh_reshard_total "
+                f"path=device +{d_device} / path=host_fallback "
+                f"+{d_host}, want +1 / +0 (the device path, not the "
+                f"replicated host bounce)")
+        if d_arrays <= 0:
+            failures.append(
+                "reshard route: no arrays moved through the device path")
+        log(f"phase 2 (dp=2): restored step {resumed}; {d_arrays} "
+            f"arrays resharded device-side")
+
+        # ---- phase 3: finish the run; one collective times out -------
+        coll.configure_collectives(timeout=30.0, retries=2,
+                                   backoff_base=0.01)
+        chaos.install(chaos.ChaosPlan("collective.timeout@1"))
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                for i in range(resumed, MESH_N_STEPS):
+                    loss = ts_2(*batches[i % len(batches)])
+                    # an eager cross-replica sync (identity in value on
+                    # a single controller): the injected timeout lands
+                    # here and must be absorbed by the retry policy
+                    loss = dist.all_reduce(loss)
+                    chaos_losses[i] = float(loss.numpy())
+        finally:
+            chaos.uninstall()
+            coll.configure_collectives()      # clear the policy
+        d_tmo = counter_val("collective_timeout_total",
+                            op="all_reduce") - base_tmo
+        d_retry = counter_val("collective_retry_total",
+                              op="all_reduce") - base_retry
+        if d_tmo < 1 or d_retry < 1:
+            failures.append(
+                f"collective.timeout: timeout_total +{d_tmo} / "
+                f"retry_total +{d_retry}, want >= 1 each (the policy "
+                f"must retry, not the supervisor)")
+        log(f"phase 3: run completed; collective.timeout retried "
+            f"({d_retry} retries)")
+
+        # ---- continuity: post-restore trajectory matches reference ---
+        for s in range(MESH_N_STEPS):
+            got = chaos_losses.get(s)
+            if got is None:
+                failures.append(
+                    f"continuity: step {s} was never executed "
+                    f"(resume landed past it)")
+            elif abs(got - ref_losses[s]) > MESH_TOL:
+                failures.append(
+                    f"continuity: loss at step {s} = {got:.6f}, "
+                    f"reference {ref_losses[s]:.6f} (tol {MESH_TOL})")
+        got_w = np.asarray(ts_2.model.weight.numpy())
+        if not np.allclose(got_w, ref_w, atol=1e-6):
+            failures.append(
+                f"continuity: final weights drift "
+                f"{np.abs(got_w - ref_w).max():.3e} from the "
+                f"uninterrupted dp=4 reference")
+        log(f"continuity: steps 0..{MESH_N_STEPS - 1} within {MESH_TOL} "
+            f"of the reference")
+    finally:
+        chaos.uninstall()
+        shutil.rmtree(root, ignore_errors=True)
+
+    if failures:
+        print("chaos_check --mesh-change FAILED:", file=out)
+        for f in failures:
+            print(f"  - {f}", file=out)
+        return 1
+    print(f"chaos_check --mesh-change OK: dp=4 run killed on fleet-step "
+          f"call {MESH_KILL_AT}, resumed on dp=2 via device-side "
+          f"resharding; "
+          f"loss trajectory within {MESH_TOL} of the uninterrupted "
+          f"reference; injected collective.timeout retried by the "
+          f"policy", file=out)
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("-v", "--verbose", action="store_true")
+    ap.add_argument("--mesh-change", action="store_true",
+                    help="run the elastic restart drill (4-device train "
+                         "-> kill -> 2-device reshard resume) instead of "
+                         "the 4-family plan")
     args = ap.parse_args(argv)
+    if args.mesh_change:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            # before any jax import: the drill needs a multi-device CPU
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        return run_mesh_change(verbose=args.verbose)
     return run(verbose=args.verbose)
 
 
