@@ -208,6 +208,40 @@ def run_gpt(preset, seq_len, batch, steps=20, warmup=3, **cfg_kw):
             "step_times_s": stimes, "devices": _dev_str()}
 
 
+def run_cold_start(preset="gpt3-125M", seq_len=256, batch=2,
+                   cache_dir=None):
+    """Cold-start leg child (ROADMAP item 4): first-step latency — from
+    TrainStep construction to the first optimizer step's host-visible
+    loss — with the persistent compile cache (jit/compile_cache.py)
+    pointed at `cache_dir`.  The parent runs this twice against ONE
+    cache dir: the first child pays trace+compile and publishes (cold),
+    the second loads the serialized executable (warm).  Each run is a
+    fresh process — exactly the restart the cache exists for."""
+    import paddle_tpu as pt
+    from paddle_tpu.jit import compile_cache as cc
+    from paddle_tpu.text import GPTConfig, GPTForCausalLM, gpt_loss_fn
+
+    cc.configure(cache_dir)
+    pt.seed(0)
+    cfg = GPTConfig.from_preset(
+        preset, vocab_size=50304, max_position_embeddings=seq_len,
+        hidden_dropout=0.0, attention_dropout=0.0, tensor_parallel=False)
+    with pt.LazyGuard():
+        model = GPTForCausalLM(cfg)
+    opt = pt.optimizer.Adafactor(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    ids = pt.randint(0, cfg.vocab_size, [batch, seq_len])
+    labels = pt.randint(0, cfg.vocab_size, [batch, seq_len])
+    t0 = time.perf_counter()
+    step = pt.jit.train_step(model, gpt_loss_fn, opt)
+    loss = float(step(ids, labels)._array)   # host read = sync
+    first_step_s = time.perf_counter() - t0
+    s = cc.stats()
+    return {"first_step_s": round(first_step_s, 3), "loss": loss,
+            "cache_hits": s["hits"], "cache_misses": s["misses"],
+            "devices": _dev_str()}
+
+
 def run_gpt_decode(preset="gpt3-125M", batch=8, prompt=128, new_tokens=128,
                    rounds=3):
     """Generation throughput: jitted prefill+KV-cache greedy decode
@@ -542,7 +576,8 @@ CHILD_FNS = {"gpt": run_gpt, "resnet": run_resnet, "llama": run_llama,
              "moe": run_moe, "bert": run_bert,
              "ernie_infer": run_ernie_infer,
              "gpt_decode": run_gpt_decode,
-             "gpt_spec_decode": run_gpt_spec_decode}
+             "gpt_spec_decode": run_gpt_spec_decode,
+             "cold_start": run_cold_start}
 
 
 def _child_main(spec):
@@ -872,6 +907,45 @@ def main():
                 "value": round(res["tps"], 1), "unit": "tokens/s/chip",
                 "vs_baseline": round(res["speedup"], 3),
                 "token_exact": res["token_exact"]}))
+    if _left() > 400:
+        # ROADMAP item 4 / PR 7: restart cost with the persistent
+        # compile cache.  Two fresh processes share one cache dir: the
+        # first compiles+publishes (cold), the second must load the
+        # serialized executable (warm) — the restart path the PR-5/6
+        # supervisors take after every backoff / hang-kill cycle.
+        import shutil
+        import tempfile
+        cdir = tempfile.mkdtemp(prefix="bench_cc_")
+        try:
+            cold = _spawn({"kind": "cold_start", "cache_dir": cdir},
+                          min(PRESET_TIMEOUT, _left()))
+            warm = None
+            if cold and _left() > 300:
+                warm = _spawn({"kind": "cold_start", "cache_dir": cdir},
+                              min(PRESET_TIMEOUT, _left()))
+            if cold and warm:
+                res = {"cold_first_step_s": cold["first_step_s"],
+                       "warm_first_step_s": warm["first_step_s"],
+                       "cold_start_speedup": round(
+                           cold["first_step_s"]
+                           / max(warm["first_step_s"], 1e-9), 2),
+                       "warm_cache_hits": warm["cache_hits"],
+                       "warm_cache_misses": warm["cache_misses"],
+                       "loss_bit_exact": cold["loss"] == warm["loss"],
+                       "devices": cold["devices"],
+                       "wall_s": cold["wall_s"] + warm["wall_s"]}
+                record["legs"]["cold_start"] = res
+                _log(json.dumps({
+                    "metric": "GPT-125M warm-cache restart first-step "
+                              "latency (persistent compile cache; "
+                              "vs_baseline = cold/warm speedup)",
+                    "value": res["warm_first_step_s"], "unit": "s",
+                    "vs_baseline": res["cold_start_speedup"],
+                    "warm_cache_hits": res["warm_cache_hits"],
+                    "warm_cache_misses": res["warm_cache_misses"],
+                    "loss_bit_exact": res["loss_bit_exact"]}))
+        finally:
+            shutil.rmtree(cdir, ignore_errors=True)
     if _left() > 500 and os.environ.get("BENCH_SKIP_27B") != "1":
         # model-ladder leg above the headline (VERDICT r2 item 8):
         # GPT-2.7B, Adafactor + recompute + pure bf16 (~5.4GB params)

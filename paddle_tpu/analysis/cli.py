@@ -38,10 +38,13 @@ def default_baseline_path():
 
 
 def self_lint_targets():
-    """The self-lint corpus: model zoo + examples (paths that exist)."""
+    """The self-lint corpus: model zoo + examples + the host-side core
+    the TL013 host rules cover (paths that exist)."""
     root = _repo_root()
     cands = [os.path.join(root, "paddle_tpu", "vision"),
              os.path.join(root, "paddle_tpu", "text"),
+             os.path.join(root, "paddle_tpu", "framework"),
+             os.path.join(root, "paddle_tpu", "tensor_api.py"),
              os.path.join(root, "examples")]
     return [p for p in cands if os.path.exists(p)]
 

@@ -91,6 +91,10 @@ class Rule:
     name = "unnamed"
     description = ""
     interests: tuple = ()
+    # host rules lint the functions file-mode otherwise skips: host-side
+    # driver code (eager decode/step loops) whose hazard is how it CALLS
+    # jit, not what happens inside a trace (e.g. TL013 recompile storms)
+    host = False
 
     def visit(self, node, fctx):
         return ()
@@ -315,20 +319,35 @@ def lint_source(source, file="<string>", rules=None):
         return [Finding(file, e.lineno or 1, (e.offset or 0) + 1, "TL999",
                         "error", f"syntax error: {e.msg}")]
     sup = parse_suppressions(source)
+    if rules is None:
+        rules = all_rules()
+    rule_list = list(rules.values()) if isinstance(rules, dict) \
+        else list(rules)
+    host_rules = [r for r in rule_list if r.host]
     findings, covered = [], set()
     for node, qualname in _iter_functions(tree):
-        # file mode lints trace-path functions only: host-side helpers
-        # legitimately print/seed numpy/sync tensors, so trace-time
-        # diagnostics there would be noise.  A def nested in an already-
+        # file mode lints trace-path functions with the full catalog;
+        # host-side helpers legitimately print/seed numpy/sync tensors,
+        # so they get only the `host` rules (how-you-call-jit hazards,
+        # e.g. TL013 recompile storms).  A def nested in an already-
         # linted function was walked with its parent — skip re-linting.
-        if id(node) in covered or not is_trace_path(node):
+        if id(node) in covered:
+            continue
+        on_trace = is_trace_path(node)
+        if not on_trace and not host_rules:
             continue
         for sub in ast.walk(node):
             if sub is not node and isinstance(
                     sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                covered.add(id(sub))
+                # a host lint must NOT swallow a nested trace-path def
+                # (the `step_fn` built inside a host factory): it still
+                # needs its own full-catalog lint
+                if on_trace or not is_trace_path(sub):
+                    covered.add(id(sub))
         findings.extend(lint_function_node(
-            node, file, qualname, rules=rules, suppressions=sup))
+            node, file, qualname,
+            rules=(rule_list if on_trace else host_rules),
+            suppressions=sup))
     return sort_findings(findings)
 
 

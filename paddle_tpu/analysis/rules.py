@@ -487,6 +487,152 @@ class ShapeDependentBranch(Rule):
                      "of the traced function")
 
 
+_SHAPE_CONSTRUCTORS = {"zeros", "ones", "full", "empty", "arange",
+                       "linspace", "eye", "broadcast_to", "tile",
+                       "reshape", "resize", "pad"}
+# dotted-prefix roots that make a bare constructor name an ARRAY
+# constructor (cuts host-side noise: `mylist.pad(i)` is not a trace)
+_ARRAY_ROOTS = {"jnp", "np", "numpy", "jax", "lax", "paddle",
+                "paddle_tpu", "pt", "T", "tensor_api", "F"}
+
+
+@register_rule
+class LoopVariantShape(Rule):
+    """TL013 — python-int shape construction inside a decode/step loop.
+
+    The recompile-storm pattern: a HOST loop builds arrays whose shape
+    depends on the loop variable, so every iteration hands jit a
+    never-seen shape and compiles a brand-new program — per-token cache
+    growth in an autoregressive decode loop is the classic offender (one
+    XLA compile per generated token; runtime RecompileWarning cause
+    "shape change").  Host-only: a python loop INSIDE a trace unrolls
+    into one program and cannot storm.
+
+    bad:  for t in range(max_new):                 # host decode loop
+              k = jnp.zeros((b, t + 1, d))         # new shape per token
+              step(ids.reshape(b, t + 1))
+    good: preallocate at a bucketed max length and mask
+          (`generation.generate(shape_buckets=...)` / `new_caches(
+          max_length=)`), or move the loop into the program (lax.scan /
+          the jitted decode loop).
+    """
+    id = "TL013"
+    severity = "warn"
+    name = "loop-variant-shape"
+    description = ("array shape built from a python loop variable — one "
+                   "compiled program per iteration (recompile storm; "
+                   "runtime cause 'shape change')")
+    interests = ()          # finish-based: owns its descent
+    host = True
+
+    @staticmethod
+    def _loop_vars(node, body_iter):
+        out = set()
+        if isinstance(node, ast.For):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        else:
+            # while-loop counters: names the body steps itself (i += 1)
+            for sub in body_iter:
+                if isinstance(sub, ast.AugAssign) and \
+                        isinstance(sub.target, ast.Name):
+                    out.add(sub.target.id)
+        return out
+
+    @staticmethod
+    def _iter_body(loop):
+        """The loop's own statements: nested loops analyze themselves,
+        nested defs/lambdas run at call time, not per-iteration here."""
+        stack = list(loop.body) + list(loop.orelse)
+        out = []
+        while stack:
+            n = stack.pop()
+            out.append(n)
+            for c in ast.iter_child_nodes(n):
+                if not isinstance(c, (ast.For, ast.While, ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    stack.append(c)
+        return out
+
+    @staticmethod
+    def _uses(expr, names):
+        return sorted(n.id for n in ast.walk(expr)
+                      if isinstance(n, ast.Name) and n.id in names
+                      and taint_of(n) < TENSOR)
+
+    def _check_loop(self, loop, fctx):
+        body = self._iter_body(loop)
+        lvars = self._loop_vars(loop, body)
+        if not lvars:
+            return
+        for sub in body:
+            if not isinstance(sub, ast.Call) or not sub.args:
+                continue
+            f = sub.func
+            if isinstance(f, ast.Attribute):
+                last, root = f.attr, _dotted(f)
+                root = root.split(".")[0] if root else None
+                # module-function form (`jnp.pad(x, ...)`) vs method
+                # form (`x.reshape(b, t)`): the receiver of a method
+                # call IS the data, so every positional arg is shape-ish
+                func_form = root in _ARRAY_ROOTS
+                is_array = func_form or isinstance(f.value, ast.Name)
+            elif isinstance(f, ast.Name):
+                # bare zeros()/pad() from-imports: function form
+                last, func_form, is_array = f.id, True, True
+            else:
+                continue
+            if last not in _SHAPE_CONSTRUCTORS or not is_array:
+                continue
+            # which positional args determine the output shape
+            if last in ("arange", "linspace"):
+                shape_args = sub.args          # start/stop/num all count
+            elif last in ("zeros", "ones", "full", "empty"):
+                shape_args = sub.args[:1]      # shape first
+            elif last == "eye":
+                shape_args = sub.args[:2]      # N, M
+            elif func_form:
+                # (data, shape/reps/pad_width, ...) — broadcast_to,
+                # tile, pad, reshape, resize
+                shape_args = (sub.args[1:2]
+                              if last in ("broadcast_to", "tile", "pad")
+                              else sub.args[1:])
+            else:
+                shape_args = sub.args          # x.reshape(b, t + 1)
+            used = sorted({v for a in shape_args
+                           for v in self._uses(a, lvars)})
+            if used:
+                yield fctx.finding(
+                    self, sub,
+                    f"'{last}' shape depends on loop variable"
+                    f"{'s' if len(used) > 1 else ''} {', '.join(used)}: "
+                    f"each iteration hands jit a new shape — one "
+                    f"compiled program per step (decode recompile "
+                    f"storm; runtime cause 'shape change')",
+                    hint="preallocate at a bucketed max size "
+                         "(generation shape_buckets / new_caches("
+                         "max_length=)) or use lax.scan")
+
+    def finish(self, fctx):
+        if fctx.trace_path:
+            return      # in-trace loops unroll into ONE program
+        stack = [fctx.node]
+        while stack:
+            n = stack.pop()
+            for c in ast.iter_child_nodes(n):
+                # descend into nested host defs (they are covered by
+                # this lint) but not nested trace-path defs (they get
+                # their own full-catalog lint)
+                if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    from .core import is_trace_path
+                    if is_trace_path(c):
+                        continue
+                stack.append(c)
+            if isinstance(n, (ast.For, ast.While)):
+                yield from self._check_loop(n, fctx)
+
+
 @register_rule
 class AssertOnTensor(Rule):
     """TL012 — `assert` over a traced tensor.

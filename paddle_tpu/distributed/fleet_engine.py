@@ -25,6 +25,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .. import observability as _obs
 from ..autograd import engine as _engine
 from ..observability import compile_tracker as _ct
+from ..jit import compile_cache as _cc
 from ..jit import functional_bridge as FB
 from ..framework import random as _random
 from ..tensor import Tensor
@@ -151,6 +152,8 @@ class DistributedTrainStep:
         self._opt_state = None
         self._step = 0
         self._placed = False
+        self._fn_cache = None   # persistent compile cache frontend (lazy)
+        self._cc_resolved = None  # (batch-shape key, runner) steady state
 
     # --------------------------------------------------------- pp splitting
     def _pp_split(self):
@@ -725,9 +728,23 @@ class DistributedTrainStep:
         in_sh = (param_in_sh, b_sh, state_sh, repl, repl, repl, batch_sh)
         out_sh = (repl, param_in_sh, b_sh, state_sh,
                   repl if check else None, repl if guarded else None)
+        # constants step_fn bakes in beyond the code: optimizer
+        # hyperparameters, model cfg, guard mode, strategy dicts, the
+        # debug-check flag — all must key the persistent cache (see the
+        # TrainStep analog in jit/train_step.py)
+        self._bake_key = _cc.config_fingerprint(
+            self.optimizer, getattr(self.model, "cfg", None),
+            self._guard, self.strategy) + repr(
+            (check, guarded, self.sharding_stage))
+        self._cc_resolved = None
+
         self._jitted = jax.jit(step_fn, in_shardings=in_sh,
                                out_shardings=out_sh,
                                donate_argnums=(0, 2))
+        # donation-free twin for the persistent compile cache (same
+        # shardings, no aliasing — see compile_cache module docstring)
+        self._plain_jit = lambda: jax.jit(step_fn, in_shardings=in_sh,
+                                          out_shardings=out_sh)
 
     def memory_stats(self, *batch):
         """AOT-compile the fused step for `batch` and return XLA's
@@ -807,16 +824,39 @@ class DistributedTrainStep:
                     list(batch_arrays)),
                 owner=self)
             t0 = time.perf_counter()
+        args = (param_tree, ba, self._opt_state, lr, step, rng,
+                batch_arrays)
+        runner, outcome = self._jitted, None
+        if _cc.enabled():
+            # persistent compile cache (the mesh fingerprint is part of
+            # the key: a resized elastic mesh can never replay a stale
+            # executable from the previous world size).  Steady state
+            # (same batch shapes) skips the full digest — see TrainStep
+            bkey = tuple((tuple(a.shape), str(a.dtype))
+                         for a in batch_arrays)
+            if (self._cc_resolved is not None
+                    and self._cc_resolved[0] == bkey):
+                runner = self._cc_resolved[1]
+            else:
+                if self._fn_cache is None:
+                    self._fn_cache = _cc.FunctionCache(
+                        f"DistributedTrainStep({type(model).__name__})",
+                        fingerprint=(type(model), self.loss_fn,
+                                     type(self.optimizer)))
+                runner, outcome, _ = self._fn_cache.lookup(
+                    self._jitted, args, static=(self._bake_key,),
+                    plain_jit=self._plain_jit)
+                self._cc_resolved = (bkey, runner)
         try:
             loss, new_params, new_buffers, self._opt_state, finite, ok = \
-                self._jitted(param_tree, ba, self._opt_state, lr, step,
-                             rng, batch_arrays)
+                runner(*args)
         except BaseException:
             if tok is not None:
                 _ct.abort(tok)
             raise
         if tok is not None:
-            _ct.finish(tok)
+            # "mem" (memo reuse) did not compile either — see TrainStep
+            _ct.finish(tok, cache_hit=(outcome in ("hit", "mem")))
         if t0 is not None:
             _obs.trace.add_complete("fleet_step", "step", t0,
                                     time.perf_counter() - t0,

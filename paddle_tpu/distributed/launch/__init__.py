@@ -37,6 +37,15 @@ def _parse_args(argv):
                    help="processes per host (1 for TPU single-controller)")
     p.add_argument("--log_dir", default=None,
                    help="per-rank stdout/stderr capture directory")
+    p.add_argument("--cache_dir", default=None,
+                   help="shared persistent compile-cache directory "
+                        "(exported as PADDLE_TPU_CACHE_DIR to every "
+                        "rank): the first rank to compile a program "
+                        "publishes the executable, restarted/backing-"
+                        "off workers cold-start from disk instead of "
+                        "recompiling (sharing is lock-free — "
+                        "concurrent ranks race benignly; see "
+                        "docs/compile_cache.md)")
     p.add_argument("--max_restarts", type=int, default=0,
                    help="elastic: restart failed workers this many times")
     p.add_argument("--restart_backoff", type=float, default=1.0,
@@ -101,6 +110,10 @@ def _worker_env(args, local_rank, restarts=0, world=None, hb_path=None):
     if hb_path:
         env["PT_HEARTBEAT_FILE"] = hb_path
         env["PT_HEARTBEAT_INTERVAL"] = str(args.heartbeat_interval)
+    if args.cache_dir:
+        # every rank shares one executable store; a restart (this very
+        # supervisor's backoff path) then skips trace+compile entirely
+        env["PADDLE_TPU_CACHE_DIR"] = os.path.abspath(args.cache_dir)
     # reference-compatible aliases user scripts may read
     env["PADDLE_TRAINER_ID"] = str(rank)
     env["PADDLE_TRAINERS_NUM"] = str(world_total)

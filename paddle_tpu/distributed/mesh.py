@@ -47,6 +47,14 @@ def set_mesh(mesh):
     _state["degrees"] = {a: mesh.shape[a] for a in mesh.axis_names}
 
 
+def clear_mesh():
+    """Uninstall the global mesh (single-process drills/tests: a leaked
+    mesh changes the compile-cache mesh fingerprint of every later jit
+    entry in the process)."""
+    _state["mesh"] = None
+    _state["degrees"] = None
+
+
 def degree(axis) -> int:
     if _state["degrees"] is None:
         return 1

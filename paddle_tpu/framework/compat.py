@@ -82,3 +82,19 @@ def axis_size(axis_name):
     if hasattr(lax, "axis_size"):
         return lax.axis_size(axis_name)
     return lax.psum(1, axis_name)
+
+
+def normalize_cost_analysis(cost):
+    """`Compiled.cost_analysis()` as ONE dict on every jax version.
+
+    The return shape moved across versions: older jax returns a
+    per-computation list ``[{...}]``, newer returns the dict directly,
+    and a backend that implements no cost model returns None/empty.
+    Callers (paddle.flops, profiler.program_stats, the sparse-conv FLOP
+    assertions) read keys like ``"flops"`` — route every read through
+    this helper instead of guessing the container."""
+    if cost is None:
+        return {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return dict(cost) if isinstance(cost, dict) else {}

@@ -21,6 +21,7 @@ from ..framework import random as _random
 from ..observability import compile_tracker as _ct
 from ..tensor import Tensor
 from ..nn.layer import Layer
+from . import compile_cache  # noqa: F401
 from . import functional_bridge as FB
 from .train_step import train_step, TrainStep  # noqa: F401
 from .save_load import InputSpec, TranslatedLayer  # noqa: F401
@@ -44,6 +45,8 @@ class StaticFunction:
         self._pure_cache = {}   # (training, static_key) -> jitted pure fn
         self._out_treedef = {}
         self._while_max_iters = while_max_iters
+        self._fn_cache = None   # persistent compile cache frontend (lazy)
+        self._cc_resolved = {}  # (key, shapes) -> resolved runner
         # dy2static: rewrite data-dependent control flow in forward onto
         # lax.cond/while_loop/scan (reference: python/paddle/jit/dy2static)
         self._conv_forward = None
@@ -134,14 +137,54 @@ class StaticFunction:
                             tuple(sorted(static_kwargs.items())),
                             in_treedef)),
                 owner=self)
+        fn_for_apply, outcome = pure, None
+        if compile_cache.enabled():
+            # persistent compile cache — inference calls only: a grad-
+            # recording apply() re-traces `pure` for the backward, which
+            # a deserialized executable cannot serve
+            will_record = engine.grad_enabled() and any(
+                not t.stop_gradient and
+                engine._is_diff_dtype(t._array.dtype)
+                for t in all_inputs)
+            if not will_record:
+                # steady state: same static key + same input shapes →
+                # the runner resolved last time, no digest recompute
+                skey = (key, tuple((t._array.shape, str(t._array.dtype))
+                                   for t in all_inputs))
+                memo = self._cc_resolved.get(skey)
+                if memo is not None:
+                    fn_for_apply = memo
+                else:
+                    if self._fn_cache is None:
+                        self._fn_cache = compile_cache.FunctionCache(
+                            f"to_static({type(layer).__name__})",
+                            fingerprint=(type(layer),))
+                    runner, outcome, extra = self._fn_cache.lookup(
+                        pure, tuple(t._array for t in all_inputs),
+                        static=(layer.training,
+                                tuple(sorted(static_kwargs.items())),
+                                repr(in_treedef),
+                                self._while_max_iters,
+                                compile_cache.config_fingerprint(
+                                    getattr(layer, "cfg", None))),
+                        extra_fn=lambda: self._out_treedef[key])
+                    if extra is not None:
+                        # trace-time metadata recovered from the cache:
+                        # the output treedef a warm restart never
+                        # traced for
+                        self._out_treedef[key] = extra
+                    fn_for_apply = runner
+                    self._cc_resolved[skey] = runner
         try:
-            result = engine.apply("to_static", pure, all_inputs)
+            result = engine.apply("to_static", fn_for_apply, all_inputs)
         except BaseException:
             if tok is not None:
                 _ct.abort(tok)
             raise
         if tok is not None:
-            _ct.finish(tok)
+            # "mem" (memo reuse) did not compile either — a phantom
+            # compile here would corrupt jit_compiles_total
+            _ct.finish(tok, cache_hit=(outcome in ("hit", "mem")))
         result = result if isinstance(result, tuple) else (result,)
         out_treedef, n_out = self._out_treedef[key]
         outs = [t for t in result[:n_out]]
@@ -207,6 +250,8 @@ def _is_static_leaf(a):
 
 def _static_fn(fn, while_max_iters=None):
     cache = {}
+    fn_caches = {}   # persistent compile cache frontends, per static key
+    cc_resolved = {}  # (key, shapes) -> resolved runner (steady state)
     fn, _ = convert_to_static(fn)
 
     @functools.wraps(fn)
@@ -251,14 +296,43 @@ def _static_fn(fn, while_max_iters=None):
                 _ct.signature_of([t._array for t in in_tensors],
                                  static=(in_treedef, statics)),
                 owner=cache)
+        fn_for_apply, outcome = pure, None
+        if compile_cache.enabled():
+            will_record = engine.grad_enabled() and any(
+                not t.stop_gradient and
+                engine._is_diff_dtype(t._array.dtype)
+                for t in in_tensors)
+            if not will_record:
+                skey = (key, tuple((t._array.shape, str(t._array.dtype))
+                                   for t in in_tensors))
+                memo = cc_resolved.get(skey)
+                if memo is not None:
+                    fn_for_apply = memo
+                else:
+                    fc = fn_caches.get(key)
+                    if fc is None:
+                        fc = fn_caches[key] = compile_cache.FunctionCache(
+                            f"to_static_fn("
+                            f"{getattr(fn, '__qualname__', '?')})",
+                            fingerprint=(fn,))
+                    runner, outcome, extra = fc.lookup(
+                        pure, tuple(t._array for t in in_tensors),
+                        static=(repr(in_treedef), statics,
+                                while_max_iters),
+                        extra_fn=lambda: (out_info["td"], out_info["n"]))
+                    if extra is not None:
+                        out_info["td"], out_info["n"] = extra
+                    fn_for_apply = runner
+                    cc_resolved[skey] = runner
         try:
-            result = engine.apply("to_static_fn", pure, in_tensors)
+            result = engine.apply("to_static_fn", fn_for_apply, in_tensors)
         except BaseException:
             if tok is not None:
                 _ct.abort(tok)
             raise
         if tok is not None:
-            _ct.finish(tok)
+            # "mem" (memo reuse) did not compile either
+            _ct.finish(tok, cache_hit=(outcome in ("hit", "mem")))
         result = result if isinstance(result, tuple) else (result,)
         return jax.tree_util.tree_unflatten(out_info["td"], list(result))
 
@@ -284,7 +358,8 @@ def save(obj, path, input_spec=None, **kwargs):
     if isinstance(obj, (Layer, StaticFunction)):
         if input_spec is None:
             raise ValueError("jit.save of a Layer requires input_spec")
-        return save_inference(obj, path, input_spec)
+        return save_inference(obj, path, input_spec,
+                              aot=bool(kwargs.get("aot", False)))
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     import numpy as np
 

@@ -17,6 +17,7 @@ from ..observability import compile_tracker as _ct
 from ..resilience import chaos as _chaos
 from ..resilience import guard as _guard
 from ..tensor import Tensor
+from . import compile_cache as _cc
 from . import functional_bridge as FB
 
 
@@ -48,6 +49,8 @@ class TrainStep:
         self._opt_state = None
         self._step = 0
         self._guard = guard if guard is not None else _guard.env_guard()
+        self._fn_cache = None   # persistent compile cache frontend (lazy)
+        self._cc_resolved = None  # (batch-shape key, runner) steady state
 
     def _build(self):
         model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
@@ -113,8 +116,24 @@ class TrainStep:
                                                  buffer_arrays)
             return loss, new_params, new_buffers, new_opt_state, finite, ok
 
+        # everything step_fn bakes in as a CONSTANT beyond the code
+        # itself must be part of the persistent-cache key: optimizer
+        # hyperparameters, model-config values, guard mode, the
+        # debug-check flag, per-param group scales/decay/frozen masks —
+        # two runs sharing a cache dir with different momentum (or one
+        # guarded, one not) must never share an executable
+        self._bake_key = _cc.config_fingerprint(
+            optimizer, getattr(model, "cfg", None), self._guard) + repr(
+            (check, tuple(p_scales), tuple(p_wds), tuple(p_frozen),
+             tuple(p_clip)))
+        self._cc_resolved = None
+
         donate = (0, 2) if self._donate else ()
         self._jitted = jax.jit(step_fn, donate_argnums=donate)
+        # donation-free twin for the persistent compile cache: what gets
+        # serialized must carry no buffer aliasing (deserialized donated
+        # executables segfault — see compile_cache module docstring)
+        self._plain_jit = ((lambda: jax.jit(step_fn)) if donate else None)
 
     def __call__(self, *batch):
         model, optimizer = self.model, self.optimizer
@@ -151,16 +170,41 @@ class TrainStep:
                 f"TrainStep({type(model).__name__})",
                 _ct.signature_of(list(pa) + list(ba) + list(batch_arrays)),
                 owner=self)
+        args = (pa, ba, self._opt_state, lr, step, rng, batch_arrays)
+        runner, outcome = self._jitted, None
+        if _cc.enabled():
+            # persistent compile cache: a warm restart loads the
+            # serialized executable instead of paying trace+compile.
+            # Steady state (same batch shapes as last call — params/
+            # opt-state shapes are fixed per instance) skips the full
+            # digest: hashing the whole arg tree per step is measurable
+            # on sub-ms steps
+            bkey = tuple((tuple(a.shape), str(a.dtype))
+                         for a in batch_arrays)
+            if (self._cc_resolved is not None
+                    and self._cc_resolved[0] == bkey):
+                runner = self._cc_resolved[1]
+            else:
+                if self._fn_cache is None:
+                    self._fn_cache = _cc.FunctionCache(
+                        f"TrainStep({type(model).__name__})",
+                        fingerprint=(type(model), self.loss_fn,
+                                     type(self.optimizer)))
+                runner, outcome, _ = self._fn_cache.lookup(
+                    self._jitted, args, static=(self._bake_key,),
+                    plain_jit=self._plain_jit)
+                self._cc_resolved = (bkey, runner)
         try:
             loss, new_params, new_buffers, self._opt_state, finite, ok = \
-                self._jitted(pa, ba, self._opt_state, lr, step, rng,
-                             batch_arrays)
+                runner(*args)
         except BaseException:
             if tok is not None:
                 _ct.abort(tok)
             raise
         if tok is not None:
-            _ct.finish(tok)
+            # "mem" (process-global memo reuse) did not compile either —
+            # reporting it as a compile would corrupt jit_compiles_total
+            _ct.finish(tok, cache_hit=(outcome in ("hit", "mem")))
         if finite is not None:
             from ..framework import debugging as _dbg
             _dbg.raise_on_nonfinite(finite, pn, self._step)

@@ -41,12 +41,13 @@ class _FnRecord:
     """Per-(owner, label) state: O(1) hash-set membership for the hot
     path, plus the last full signature for cause diagnosis."""
 
-    __slots__ = ("hashes", "last", "count")
+    __slots__ = ("hashes", "last", "count", "warned_causes")
 
     def __init__(self):
         self.hashes = set()
         self.last = None
         self.count = 0
+        self.warned_causes = set()
 
 
 _lock = threading.Lock()
@@ -157,7 +158,13 @@ def on_call(label, sig, owner=None):
         prev_last, rec.last = rec.last, sig
         rec.count += 1
         index = rec.count
-    if index > _warn_after:
+        # one warning per (fn, cause) pair: a decode loop recompiling
+        # per token length would otherwise warn on EVERY new length —
+        # the first "shape change" warning carries all the signal
+        warn = index > _warn_after and cause not in rec.warned_causes
+        if warn:
+            rec.warned_causes.add(cause)
+    if warn:
         warnings.warn(
             f"{label} compiled {index} times (latest cause: {cause}); "
             f"recompilation dominates step time — stabilize input "
@@ -182,14 +189,27 @@ def abort(token):
             rec.last = token.prev_last
 
 
-def finish(token):
-    """Close a compile event opened by on_call; records metrics + trace."""
+def finish(token, cache_hit=False):
+    """Close a compile event opened by on_call; records metrics + trace.
+
+    `cache_hit=True` marks a new-signature call that was served from the
+    persistent compile cache (jit/compile_cache.py): the event is kept
+    (with cause "persistent cache hit") so the timeline shows the load,
+    but it does NOT count as a compile — the cold-start drill asserts a
+    warm restart leaves `jit_compiles_total` untouched."""
     wall = time.perf_counter() - token.t0
-    ev = CompileEvent(token.label, token.cause, wall, token.t0, token.index)
+    cause = "persistent cache hit" if cache_hit else token.cause
+    ev = CompileEvent(token.label, cause, wall, token.t0, token.index)
     with _lock:
         _events.append(ev)
     from . import metrics, trace
     reg = metrics.registry()
+    if cache_hit:
+        reg.counter("jit_persistent_cache_hits_total",
+                    fn=token.label).inc()
+        trace.add_complete(f"cache-hit:{token.label}", "compile",
+                           token.t0, wall, args={"n": token.index})
+        return ev
     reg.counter("jit_compiles_total", fn=token.label).inc()
     reg.counter("jit_recompiles_total", fn=token.label,
                 cause=token.cause).inc()

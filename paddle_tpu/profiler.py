@@ -207,9 +207,10 @@ def program_stats(fn, *args, **kwargs):
     flops, bytes accessed, and (when the backend reports it) estimated
     seconds.  `fn` is any jax-traceable callable (e.g. a jitted step's
     underlying function) called with example args."""
+    from .framework.compat import normalize_cost_analysis
     lowered = jax.jit(lambda *a: fn(*a, **kwargs)).lower(*args)
-    cost = lowered.compile().cost_analysis()
-    if not isinstance(cost, dict):
+    cost = normalize_cost_analysis(lowered.compile().cost_analysis())
+    if not cost:
         return {}
     out = {"flops": cost.get("flops", 0.0)}
     for k, v in cost.items():

@@ -43,6 +43,13 @@ Fault sites (see docs/resilience.md for the full table):
     loader.worker_kill          loader worker exits hard (SIGKILL-like)
     loader.worker_hang          loader worker hangs forever
     loader.batch_corrupt        loader worker ships a corrupt payload
+    cache.corrupt               flip bytes in a just-published compile-
+                                cache entry (reader must quarantine)
+    cache.race                  a competing worker publishes the same
+                                compile-cache entry first (last-writer-
+                                wins must stay torn-free)
+    cache.evict_inflight        GC collects a compile-cache entry right
+                                after publish (reader sees a clean miss)
 
 Zero-cost when disabled: every site guards on the module-level
 ``_PLAN is None`` check before doing any work.
@@ -266,6 +273,36 @@ def poison_batch(batch_arrays):
     if not done and out:  # integer-only batch: poison via the first array
         out[0] = out[0] * 0 + np.iinfo(np.int32).max
     return tuple(out)
+
+
+def corrupt_cache_entry(cache_dir, which=0, mode="flip"):
+    """Deterministically damage an on-disk compile-cache entry (newest
+    first by `which` ordinal).  Modes: ``flip`` (overwrite bytes inside
+    the payload — checksum mismatch), ``truncate`` (cut the entry in
+    half — torn write), ``garbage`` (replace the whole file).  Returns
+    the damaged path; the next reader must quarantine it and recompile
+    (chaos_check --cold-start asserts exactly that)."""
+    entries = sorted(
+        (os.path.join(cache_dir, n) for n in os.listdir(cache_dir)
+         if n.endswith(".ccx")),
+        key=os.path.getmtime, reverse=True)
+    if not entries:
+        raise FileNotFoundError(f"no cache entries under {cache_dir}")
+    victim = entries[min(which, len(entries) - 1)]
+    size = os.path.getsize(victim)
+    if mode == "flip":
+        with open(victim, "r+b") as f:
+            f.seek(max(size - 24, 16))
+            f.write(b"\xa5" * 8)
+    elif mode == "truncate":
+        with open(victim, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+    elif mode == "garbage":
+        with open(victim, "wb") as f:
+            f.write(b"\x00not-a-cache-entry")
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    return victim
 
 
 def corrupt_checkpoint(path, mode="truncate_arrays"):

@@ -1,7 +1,23 @@
 """Autoregressive decoding with KV cache (reference analog: PaddleNLP
 generation_utils).  Eager loop over jitted single-token steps; greedy,
-temperature sampling, top-k, top-p."""
+temperature sampling, top-k, top-p.
+
+Shape bucketing (`shape_buckets=` / ``PADDLE_TPU_SHAPE_BUCKETS``): the
+plain eager loop uses concat-style caches, so EVERY generated token has
+a new cache length — one fresh XLA program per token per op, the classic
+decode recompile storm the compile tracker diagnoses as cause "shape
+change" (tracelint TL010/TL013).  The bucketed path pads the prompt up
+to a size bucket and runs the loop over the models' PREALLOCATED
+static-shape caches instead: one prefill program per prompt bucket, ONE
+decode program for every token.  Padded key/value slots stay invisible —
+the length mask `cols <= pos + row` excludes them and each decode write
+lands exactly at the next visible slot — so output tokens are identical
+to the unbucketed loop.
+"""
 from __future__ import annotations
+
+import os
+import warnings
 
 import numpy as np
 import jax
@@ -9,6 +25,79 @@ import jax.numpy as jnp
 
 from ..framework import random as _random
 from ..tensor import Tensor
+
+
+class BucketPolicy:
+    """Pad-to-bucket policy for decode shapes.
+
+    `buckets` is an explicit ascending list of lengths; lengths beyond
+    the last bucket keep doubling from it.  The default geometric ladder
+    (32, 64, 128, ...) bounds the number of distinct prefill programs to
+    log2(max prompt) while wasting at most 2x compute on the prefill.
+    """
+
+    def __init__(self, buckets=None, min_bucket=32):
+        self.buckets = sorted(int(b) for b in buckets) if buckets else []
+        self.min_bucket = int(min_bucket)
+
+    def bucket(self, n):
+        """Smallest bucket >= n."""
+        n = int(n)
+        for b in self.buckets:
+            if n <= b:
+                return b
+        b = self.buckets[-1] if self.buckets else self.min_bucket
+        while b < n:
+            b *= 2
+        return b
+
+    @classmethod
+    def from_spec(cls, spec):
+        """None/"0"/"off" -> None; "1"/"on"/"auto" -> default ladder;
+        "64,128,512" -> explicit buckets."""
+        if spec is None:
+            return None
+        s = str(spec).strip().lower()
+        if s in ("", "0", "off", "false", "none"):
+            return None
+        if s in ("1", "on", "true", "auto"):
+            return cls()
+        return cls(buckets=[int(p) for p in s.split(",") if p.strip()])
+
+
+def _tracker_wants_buckets(model):
+    """The "auto" signal: has the compile tracker already diagnosed a
+    shape-change recompile storm for this model's jit entries?  (The
+    runtime half of tracelint TL010/TL013 — see docs/compile_cache.md.)"""
+    try:
+        from ..observability import compile_tracker as _ct
+        name = type(model).__name__
+        n = sum(1 for e in _ct.events()
+                if "shape" in e.cause and name in e.label)
+        return n >= 2
+    except Exception:  # pragma: no cover - telemetry must never break
+        return False
+
+
+def _resolve_bucket_policy(shape_buckets, model):
+    """The active BucketPolicy for this generate() call, or None.
+
+    Explicit arg wins; unset falls back to PADDLE_TPU_SHAPE_BUCKETS.
+    "auto" (arg or env) enables bucketing only once the compile tracker
+    has recorded shape-change recompiles for this model — the
+    recompile-storm evidence drives the policy, zero behavior change
+    before the storm is real.
+    """
+    spec = shape_buckets
+    if spec is None:
+        spec = os.environ.get("PADDLE_TPU_SHAPE_BUCKETS") or None
+    if isinstance(spec, BucketPolicy):
+        return spec
+    if isinstance(spec, (list, tuple)):
+        return BucketPolicy(buckets=spec)
+    if isinstance(spec, str) and spec.strip().lower() == "auto":
+        return BucketPolicy() if _tracker_wants_buckets(model) else None
+    return BucketPolicy.from_spec(spec)
 
 
 def filter_logits(logits, temperature, top_k, top_p):
@@ -38,7 +127,7 @@ def _sample_next(logits, temperature, top_k, top_p, greedy):
 def generate(model, input_ids, max_new_tokens=20, do_sample=False,
              temperature=1.0, top_k=None, top_p=None, eos_token_id=None,
              draft_model=None, num_speculative_tokens=4, num_beams=1,
-             length_penalty=1.0):
+             length_penalty=1.0, shape_buckets=None):
     """Returns Tensor [b, prompt + new] of token ids.  Passing
     ``draft_model`` routes through speculative decoding
     (decode.speculative_generate): greedy output is token-identical to
@@ -47,7 +136,11 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
     consumes a different RNG stream, so individual tokens differ).
     ``num_beams > 1`` routes through the jitted beam search
     (decode.jit_beam_search — the whole beam loop is one compiled
-    program)."""
+    program).  ``shape_buckets`` (or ``PADDLE_TPU_SHAPE_BUCKETS``)
+    enables the pad-to-bucket decode path over preallocated caches —
+    token-identical output, but a bounded number of compiled programs
+    instead of one per generated token ("auto" arms it only after the
+    compile tracker has diagnosed a shape-change recompile storm)."""
     if num_beams > 1:
         if do_sample or draft_model is not None:
             raise NotImplementedError(
@@ -66,11 +159,16 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
             num_speculative_tokens=num_speculative_tokens,
             do_sample=do_sample, temperature=temperature, top_k=top_k,
             top_p=top_p, eos_token_id=eos_token_id)
+    policy = _resolve_bucket_policy(shape_buckets, model)
     was_training = model.training
     model.eval()
     try:
         from ..autograd import engine
         with engine.no_grad():
+            if policy is not None:
+                return _bucketed_generate(
+                    model, input_ids, max_new_tokens, do_sample,
+                    temperature, top_k, top_p, eos_token_id, policy)
             b = input_ids.shape[0]
             dtype = next(iter(model.parameters()))._array.dtype
             caches = model.new_caches(b, dtype=dtype)
@@ -97,6 +195,90 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
     finally:
         if was_training:
             model.train()
+
+
+def _set_cache_pos(caches, pos):
+    for c in caches:
+        c["pos"] = Tensor._from_array(jnp.asarray(pos, jnp.int32))
+
+
+def _bucketed_generate(model, input_ids, max_new_tokens, do_sample,
+                       temperature, top_k, top_p, eos_token_id, policy):
+    """The storm-free decode loop: preallocated static-shape caches +
+    prompt padded to a bucket.
+
+    Shape inventory: one prefill program per (batch, prompt-bucket), one
+    decode program per batch — independent of prompt length and token
+    count.  Correctness of the padding: the prefill writes junk k/v into
+    slots [prompt, prompt_bucket), but the length mask only ever exposes
+    `cols <= pos + row`, and decode step t writes slot prompt+t BEFORE
+    the mask first admits it — padded slots are overwritten exactly as
+    they become visible, so every attended key is real and the emitted
+    tokens match the unbucketed loop.
+    """
+    b, prompt = input_ids.shape
+    dtype = next(iter(model.parameters()))._array.dtype
+    max_pos = getattr(getattr(model, "cfg", None),
+                      "max_position_embeddings", None)
+    if max_pos is not None and prompt + max_new_tokens > int(max_pos):
+        # preallocated caches cannot exceed the position table; a
+        # request already past it keeps the unbucketed loop's semantics
+        # instead of silently clamping positions into the last slot
+        warnings.warn(
+            f"generation request ({prompt} prompt + {max_new_tokens} "
+            f"new) exceeds max_position_embeddings={max_pos}; shape "
+            f"bucketing disabled for this call", UserWarning,
+            stacklevel=3)
+        return generate(model, input_ids, max_new_tokens=max_new_tokens,
+                        do_sample=do_sample, temperature=temperature,
+                        top_k=top_k, top_p=top_p,
+                        eos_token_id=eos_token_id, shape_buckets="off")
+    cap = policy.bucket(prompt + max_new_tokens)
+    pb = max(policy.bucket(prompt), prompt)
+    if max_pos is not None:
+        cap = min(cap, int(max_pos))
+        pb = min(pb, int(max_pos))
+    cap = max(cap, prompt + max_new_tokens)
+    pb = min(max(pb, prompt), cap)
+    try:
+        caches = model.new_caches(b, dtype=dtype, max_length=cap)
+    except TypeError:
+        warnings.warn(
+            f"{type(model).__name__} does not support preallocated "
+            f"caches (new_caches(max_length=)); shape bucketing "
+            f"disabled for this call", UserWarning, stacklevel=3)
+        return generate(model, input_ids, max_new_tokens=max_new_tokens,
+                        do_sample=do_sample, temperature=temperature,
+                        top_k=top_k, top_p=top_p,
+                        eos_token_id=eos_token_id, shape_buckets="off")
+    from ..observability import metrics as _metrics
+    reg = _metrics.registry()
+    reg.counter("generation_bucketed_calls_total").inc()
+    reg.counter("generation_bucket_pad_tokens_total").inc(
+        (pb - prompt) * b)
+    ids = input_ids._array
+    pad_id = eos_token_id if eos_token_id is not None else 0
+    padded = jnp.pad(ids, ((0, 0), (0, pb - prompt)),
+                     constant_values=pad_id) if pb > prompt else ids
+    logits = model(Tensor._from_array(padded), caches=caches)
+    next_tok = _sample_next(
+        logits._array[:, prompt - 1, :].astype(jnp.float32), temperature,
+        top_k, top_p, greedy=not do_sample)
+    out = [np.asarray(ids), np.asarray(next_tok)[:, None]]
+    finished = np.zeros(b, bool)
+    for t in range(max_new_tokens - 1):
+        if eos_token_id is not None:
+            finished |= (out[-1][:, 0] == eos_token_id)
+            if finished.all():
+                break
+        _set_cache_pos(caches, prompt + t)
+        cur = Tensor._from_array(jnp.asarray(out[-1], dtype=ids.dtype))
+        logits = model(cur, caches=caches)   # [b, 1, V] — static shapes
+        next_tok = _sample_next(
+            logits._array[:, -1, :].astype(jnp.float32),
+            temperature, top_k, top_p, greedy=not do_sample)
+        out.append(np.asarray(next_tok)[:, None])
+    return Tensor(np.concatenate(out, axis=1))
 
 
 def beam_search(model, input_ids, beam_size=4, max_new_tokens=20,

@@ -645,6 +645,24 @@ def test_chaos_check_mesh_change_inprocess():
     assert "resumed on dp=2 via device-side resharding" in buf.getvalue()
 
 
+def test_chaos_check_cold_start_inprocess():
+    """The cold-start drill: a run trained with a persistent compile
+    cache is killed; the restart (a REAL subprocess) performs zero
+    compilations — every jit entry loads its serialized executable —
+    with bit-exact loss/weight continuity; a deterministically corrupted
+    cache entry is quarantined and transparently recompiled."""
+    import io
+    from paddle_tpu.jit import compile_cache as cc
+    buf = io.StringIO()
+    try:
+        rc = _load_chaos_check().run_cold_start(out=buf)
+    finally:
+        cc.reset()
+    assert rc == 0, buf.getvalue()
+    assert "zero recompiles" in buf.getvalue()
+    assert "quarantined" in buf.getvalue()
+
+
 @pytest.mark.slow
 def test_chaos_check_mesh_change_subprocess():
     r = subprocess.run(

@@ -114,10 +114,10 @@ def test_subm_conv_flops_scale_with_nnz_not_volume():
             layer = SubmConv3D(C, C, kernel_size=3)
             x = _random_sparse(vol=vol, C=C, nsites=nsites, seed=3)
             layer(x)
+            from paddle_tpu.framework.compat import normalize_cost_analysis
             f = jax.jit(captured["fn"])
-            cost = f.lower(*captured["args"]).compile().cost_analysis()
-            if isinstance(cost, list):  # older jax returns [dict]
-                cost = cost[0]
+            cost = normalize_cost_analysis(
+                f.lower(*captured["args"]).compile().cost_analysis())
             flops[nsites] = float(cost["flops"])
     finally:
         eng.apply = orig
@@ -273,7 +273,9 @@ def test_jit_flops_scale_with_nnz():
                 jsparse.BCOO((vals, bco.indices), shape=bco.shape))
             return layer(xs).values()._array
 
-        c = jax.jit(f).lower(bco.data).compile().cost_analysis()
+        from paddle_tpu.framework.compat import normalize_cost_analysis
+        c = normalize_cost_analysis(
+            jax.jit(f).lower(bco.data).compile().cost_analysis())
         return c.get("flops", 0.0)
 
     f1, f2 = flops(100), flops(200)

@@ -45,7 +45,11 @@ def test_registry_has_at_least_ten_distinct_rules():
     for r in rules.values():
         assert r.severity in analysis.SEVERITIES
         assert r.id.startswith("TL")
-        assert r.interests, f"{r.id} declares no visitor interests"
+        # a rule participates either via visitor interests or by owning
+        # its own descent in finish() (e.g. TL013 walks host loops)
+        assert (r.interests
+                or type(r).finish is not analysis.Rule.finish), \
+            f"{r.id} declares no visitor interests and no finish()"
 
 
 def test_finding_shape_and_sorting():
@@ -435,3 +439,59 @@ def test_cli_self_inprocess():
 def test_cli_self_subprocess():
     r = _run_cli("--self")
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ===================================================================
+# TL013: loop-variant shapes in HOST decode/step loops (PR 7)
+# ===================================================================
+def test_tl013_fires_on_host_decode_loop_constructors():
+    # constructor function-form: shape arg is args[0]
+    src = ("import jax.numpy as jnp\n"
+           "def decode(model, ids, b, d, max_new):\n"
+           "    for t in range(max_new):\n"
+           "        k = jnp.zeros((b, t + 1, d))\n"
+           "        ids = model(ids, k)\n"
+           "    return ids\n")
+    assert "TL013" in rules_fired(src)
+    # data-first function-form: the shape arg is the SECOND positional
+    for call in ("jnp.broadcast_to(x, (b, t + 1, d))",
+                 "jnp.tile(x, (1, t + 1))",
+                 "jnp.pad(x, ((0, t), (0, 0)))",
+                 "jnp.reshape(x, (b, t + 1))"):
+        src = ("import jax.numpy as jnp\n"
+               "def decode(x, b, d, max_new):\n"
+               "    for t in range(max_new):\n"
+               f"        x2 = {call}\n"
+               "    return x2\n")
+        assert "TL013" in rules_fired(src), call
+    # method form: every positional arg is shape-ish
+    src = ("def step(x, b, max_new):\n"
+           "    for t in range(max_new):\n"
+           "        y = x.reshape(b, t + 1)\n"
+           "    return y\n")
+    assert "TL013" in rules_fired(src)
+
+
+def test_tl013_silent_on_safe_loops():
+    # loop-invariant shapes: no storm
+    src = ("import jax.numpy as jnp\n"
+           "def decode(x, b, d, max_new):\n"
+           "    for t in range(max_new):\n"
+           "        k = jnp.zeros((b, 64, d))\n"
+           "    return k\n")
+    assert "TL013" not in rules_fired(src)
+    # data-first function form with a loop-variant DATA arg only: the
+    # output shape follows the pad widths, not the array argument
+    src = ("import jax.numpy as jnp\n"
+           "def decode(xs, max_new):\n"
+           "    for t in range(max_new):\n"
+           "        y = jnp.pad(xs[t], ((0, 4), (0, 0)))\n"
+           "    return y\n")
+    assert "TL013" not in rules_fired(src)
+    # a loop INSIDE a trace-path function unrolls into one program
+    src = ("import jax.numpy as jnp\n"
+           "def forward(x, b):\n"
+           "    for t in range(4):\n"
+           "        x = x + jnp.zeros((b, t + 1))\n"
+           "    return x\n")
+    assert "TL013" not in rules_fired(src)

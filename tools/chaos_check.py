@@ -32,12 +32,21 @@ post-restore loss trajectory must match the uninterrupted 4-device
 reference within ``MESH_TOL`` (dp=4 vs dp=2 only changes the reduction
 grouping of the same global batch).
 
-Usage:  python tools/chaos_check.py [-v] [--mesh-change]
+``--cold-start`` runs the **compile-cache drill** instead: train with a
+persistent compile cache (jit/compile_cache.py), kill, restart with the
+warm cache — the restarted run must perform ZERO compilations (every
+jit entry loads its serialized executable) with bit-exact loss
+continuity vs an uninterrupted reference; then a deterministically
+corrupted cache entry must be quarantined and silently recompiled.
+
+Usage:  python tools/chaos_check.py [-v] [--mesh-change] [--cold-start]
 Exit 0 = all recovery paths green.
 """
 import argparse
 import io
+import json
 import os
+import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -239,6 +248,242 @@ def run(out=None, verbose=False):
     return 0
 
 
+# ========================================================= --cold-start
+COLD_N_STEPS = 8    # optimizer steps in the cold-start drill
+COLD_KILL_AT = 4    # "process death" after this many steps
+
+
+def run_cold_worker(cache_dir, root, out=None):
+    """The restarted process of the cold-start drill: restore the
+    checkpoint, drive to COLD_N_STEPS against the (supposedly) warm
+    cache, and report one JSON line — losses per step, final weights,
+    and every cache/compile counter the parent asserts on.
+
+    This runs in a REAL subprocess, not an in-process simulation: a
+    genuine restart never holds a live instance of the executables it
+    loads, which is both the scenario the cache exists for and the only
+    configuration jaxlib supports (deserializing a program the same
+    process already compiled is a known double-instance segfault — see
+    compile_cache._MEMO)."""
+    out = out if out is not None else sys.stdout
+    import warnings
+
+    import numpy as np
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.jit import compile_cache as cc
+    from paddle_tpu.observability.metrics import MetricsRegistry
+    from paddle_tpu.resilience.manager import CheckpointManager
+
+    reg = MetricsRegistry()
+    obs.enable(reg)
+    cc.configure(cache_dir)
+    batches = [tuple(b if isinstance(b, (list, tuple)) else [b])
+               for b in DataLoader(_DrillDataset(), batch_size=2,
+                                   num_workers=0)]
+    model, ts = _fresh_step()
+    mgr = CheckpointManager(root, max_to_keep=2)
+    meta = mgr.restore(train_step=ts)
+    losses = {}
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", cc.CacheUnavailableWarning)
+        _drive(ts, batches, COLD_N_STEPS, losses)
+    stats = cc.stats()
+    stats["compiles"] = sum(
+        r.get("value", 0) for r in reg.snapshot()
+        if r["name"] == "jit_compiles_total"
+        and "TrainStep" in r["labels"].get("fn", ""))
+    stats["cache_hits"] = sum(
+        r.get("value", 0) for r in reg.snapshot()
+        if r["name"] == "jit_persistent_cache_hits_total")
+    print(json.dumps({
+        "restored_step": meta.get("step"),
+        "losses": {str(k): v for k, v in losses.items()},
+        "weights": np.asarray(model.weight.numpy(),
+                              dtype=np.float64).ravel().tolist(),
+        "stats": stats,
+    }), file=out, flush=True)
+    return 0
+
+
+def _spawn_cold_worker(cache_dir, root):
+    """Run run_cold_worker in a fresh interpreter; returns (rc, report
+    dict or None, raw output)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--cold-start-worker",
+         "--cache-dir", cache_dir, "--ckpt-root", root],
+        capture_output=True, text=True, timeout=600)
+    report = None
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                report = json.loads(line)
+            except json.JSONDecodeError:
+                pass
+            break
+    return proc.returncode, report, proc.stdout + proc.stderr
+
+
+def run_cold_start(out=None, verbose=False):
+    """The cold-start drill: train with a persistent compile cache →
+    kill → restart (a REAL subprocess) with the warm cache → the
+    restarted process must perform ZERO compilations (every jit entry
+    loads its serialized executable) and land on bit-exact losses and
+    weights vs an uninterrupted reference.  Then an injected corrupt
+    cache entry must be quarantined and transparently recompiled —
+    counter incremented, no crash, losses still exact."""
+    out = out if out is not None else sys.stdout
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import observability as obs
+    from paddle_tpu.jit import compile_cache as cc
+    from paddle_tpu.observability.metrics import MetricsRegistry
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.resilience.manager import CheckpointManager
+
+    def log(msg):
+        if verbose:
+            print(msg, file=out)
+
+    cache_dir = tempfile.mkdtemp(prefix="chaos_cc_cache_")
+    root = tempfile.mkdtemp(prefix="chaos_cc_ckpt_")
+    reg = MetricsRegistry()
+    obs.enable(reg)
+    # a mesh leaked by an earlier in-process caller (e.g. the
+    # mesh-change drill) would enter THIS process's compile-cache keys
+    # but not the fresh restart subprocess's — every warm lookup would
+    # spuriously miss; the drill keyspace must match a clean restart
+    from paddle_tpu.distributed import mesh as _mesh
+    prior_mesh = _mesh._state["mesh"]
+    _mesh.clear_mesh()
+    failures = []
+    try:
+        ref_batches = [tuple(b if isinstance(b, (list, tuple)) else [b])
+                       for b in __import__("paddle_tpu").io.DataLoader(
+                           _DrillDataset(), batch_size=2, num_workers=0)]
+
+        def counters():
+            s = cc.stats()
+            s["compiles"] = sum(
+                r.get("value", 0) for r in reg.snapshot()
+                if r["name"] == "jit_compiles_total"
+                and "TrainStep" in r["labels"].get("fn", ""))
+            return s
+
+        # ---- reference: cache disabled, plain jit ---------------------
+        cc.configure(None)
+        _, ref_ts = _fresh_step()
+        ref_losses = {}
+        _drive(ref_ts, ref_batches, COLD_N_STEPS, ref_losses)
+        ref_w = np.asarray(ref_ts.model.weight.numpy(),
+                           dtype=np.float64).ravel()
+        log(f"reference: {COLD_N_STEPS} steps, final loss "
+            f"{ref_losses[COLD_N_STEPS]:.6f}")
+
+        # ---- phase 1: cold run with an empty cache, killed mid-way ----
+        cc.configure(cache_dir)
+        base = counters()
+        mgr = CheckpointManager(root, max_to_keep=2)
+        _, ts1 = _fresh_step()
+        cold_losses = {}
+        _drive(ts1, ref_batches, COLD_KILL_AT, cold_losses)
+        mgr.save(COLD_KILL_AT, train_step=ts1)
+        after_cold = counters()
+        if after_cold["misses"] - base["misses"] < 1:
+            failures.append("cold run: no cache miss recorded (the "
+                            "first compile never published)")
+        if after_cold["compiles"] - base["compiles"] < 1:
+            failures.append("cold run: compile tracker saw no compile")
+        log(f"phase 1 (cold): {COLD_KILL_AT} steps, "
+            f"{after_cold['misses'] - base['misses']} miss(es) "
+            f"published; killed")
+
+        def check_continuity(tag, report, from_step=1):
+            losses = report.get("losses", {})
+            for s in range(from_step, COLD_N_STEPS + 1):
+                got = losses.get(str(s))
+                if got != ref_losses[s]:
+                    failures.append(
+                        f"{tag}: loss at step {s} = {got!r} != reference "
+                        f"{ref_losses[s]!r} (must be bit-exact)")
+            got_w = np.asarray(report.get("weights", []), dtype=np.float64)
+            if not np.array_equal(got_w, ref_w):
+                failures.append(f"{tag}: final weights differ (must be "
+                                f"bit-exact)")
+            if report.get("restored_step") != COLD_KILL_AT:
+                failures.append(
+                    f"{tag}: restore landed on step "
+                    f"{report.get('restored_step')}, want {COLD_KILL_AT}")
+
+        # ---- phase 2: warm restart (subprocess) — ZERO recompiles -----
+        rc, report, raw = _spawn_cold_worker(cache_dir, root)
+        if rc != 0 or report is None:
+            failures.append(
+                f"warm restart process died (rc={rc}):\n{raw[-2000:]}")
+        else:
+            s2 = report["stats"]
+            if s2["compiles"] != 0:
+                failures.append(
+                    f"warm restart COMPILED {s2['compiles']} time(s) — "
+                    f"the whole point is zero recompiles")
+            if s2["misses"] != 0:
+                failures.append(f"warm restart missed the cache "
+                                f"{s2['misses']} time(s), want 0")
+            if s2["hits"] < 1 or s2["cache_hits"] < 1:
+                failures.append(
+                    f"warm restart: hits {s2['hits']} / tracker "
+                    f"cache-hits {s2['cache_hits']}, want >= 1 each")
+            check_continuity("warm restart", report,
+                             from_step=COLD_KILL_AT + 1)
+            log(f"phase 2 (warm subprocess): 0 compiles, "
+                f"{s2['hits']} cache hit(s), losses bit-exact through "
+                f"step {COLD_N_STEPS}")
+
+        # ---- phase 3: corrupt entry → quarantine + silent recompile ---
+        victim = chaos.corrupt_cache_entry(cache_dir, mode="flip")
+        rc, report, raw = _spawn_cold_worker(cache_dir, root)
+        if rc != 0 or report is None:
+            failures.append(
+                f"corrupt-entry restart CRASHED (rc={rc}) — quarantine "
+                f"must degrade, never abort:\n{raw[-2000:]}")
+        else:
+            s3 = report["stats"]
+            if s3["quarantined"] < 1:
+                failures.append(
+                    "corrupt entry was NOT quarantined (counter "
+                    "unchanged)")
+            if s3["misses"] < 1:
+                failures.append(
+                    "corrupt entry: no silent recompile after quarantine")
+            check_continuity("corrupt-recovery", report,
+                             from_step=COLD_KILL_AT + 1)
+            log(f"phase 3 (corrupt): {os.path.basename(victim)} "
+                f"quarantined, recompiled silently, losses exact")
+    finally:
+        obs.disable()
+        cc.reset()
+        if prior_mesh is not None:
+            _mesh.set_mesh(prior_mesh)
+        shutil.rmtree(cache_dir, ignore_errors=True)
+        shutil.rmtree(root, ignore_errors=True)
+
+    if failures:
+        print("chaos_check --cold-start FAILED:", file=out)
+        for f in failures:
+            print(f"  - {f}", file=out)
+        return 1
+    print(f"chaos_check --cold-start OK: warm-cache restart performed "
+          f"zero recompiles with bit-exact loss continuity; corrupt "
+          f"entry quarantined + silently recompiled", file=out)
+    return 0
+
+
 # ======================================================== --mesh-change
 MESH_N_STEPS = 8    # optimizer steps in the elastic drill
 MESH_KILL_AT = 6    # restart.mesh_change fires on this fleet-step call
@@ -427,6 +672,11 @@ def run_mesh_change(out=None, verbose=False):
             f"of the reference")
     finally:
         chaos.uninstall()
+        # _fleet_step installed a global mesh; a leaked one would leak
+        # into the mesh fingerprint of every later jit entry in this
+        # process (e.g. the cold-start drill's compile-cache keys)
+        from paddle_tpu.distributed import mesh as _mesh
+        _mesh.clear_mesh()
         shutil.rmtree(root, ignore_errors=True)
 
     if failures:
@@ -450,7 +700,20 @@ def main(argv=None):
                     help="run the elastic restart drill (4-device train "
                          "-> kill -> 2-device reshard resume) instead of "
                          "the 4-family plan")
+    ap.add_argument("--cold-start", action="store_true",
+                    help="run the compile-cache cold-start drill (train "
+                         "-> kill -> warm-cache restart with zero "
+                         "recompiles; corrupt entry -> quarantine) "
+                         "instead of the 4-family plan")
+    ap.add_argument("--cold-start-worker", action="store_true",
+                    help=argparse.SUPPRESS)   # the drill's restarted proc
+    ap.add_argument("--cache-dir", help=argparse.SUPPRESS)
+    ap.add_argument("--ckpt-root", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+    if args.cold_start_worker:
+        return run_cold_worker(args.cache_dir, args.ckpt_root)
+    if args.cold_start:
+        return run_cold_start(verbose=args.verbose)
     if args.mesh_change:
         flags = os.environ.get("XLA_FLAGS", "")
         if "host_platform_device_count" not in flags:
