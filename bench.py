@@ -374,6 +374,120 @@ def run_gpt_spec_decode(preset="gpt3-350M", draft_layers=2, batch=4,
             "devices": _dev_str()}
 
 
+def run_serving(preset="gpt3-125M", n_requests=24, arrival_rate=8.0,
+                prompt_lo=16, prompt_hi=96, new_tokens=32,
+                num_blocks=None, block_size=16, max_running=8,
+                seed=0, **cfg_kw):
+    """Serving throughput leg: the continuous-batching engine
+    (paddle_tpu/serving) against a seeded Poisson arrival trace, vs
+    SEQUENTIAL serving of the same trace (one `jit_generate` per request,
+    FCFS).  Reports aggregate tokens/s, requests/s and TTFT/TPOT
+    p50/p99 — the serving-relevant percentiles, measured per request
+    from its (virtual) arrival time."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.serving import LLMEngine
+    from paddle_tpu.text import GPTConfig, GPTForCausalLM
+    from paddle_tpu.text.decode import jit_generate
+
+    pt.seed(0)
+    max_len = prompt_hi + new_tokens
+    cfg = GPTConfig.from_preset(
+        preset, vocab_size=50304, max_position_embeddings=max_len,
+        hidden_dropout=0.0, attention_dropout=0.0, tensor_parallel=False,
+        **cfg_kw)
+    with pt.LazyGuard():
+        model = GPTForCausalLM(cfg)
+    rs = np.random.RandomState(seed)
+    prompts = [rs.randint(0, cfg.vocab_size,
+                          size=rs.randint(prompt_lo, prompt_hi + 1))
+               .tolist() for _ in range(n_requests)]
+    # seeded Poisson arrivals: exponential inter-arrival gaps
+    arrivals = np.cumsum(rs.exponential(1.0 / arrival_rate, n_requests))
+
+    if num_blocks is None:
+        # pool sized for ~max_running concurrent max-length requests
+        num_blocks = max_running * (-(-max_len // block_size)) + 4
+    eng = LLMEngine(model, num_blocks=num_blocks, block_size=block_size,
+                    max_running=max_running, prefill_chunk=64)
+    # warm every program shape out of band (compiles don't belong in a
+    # throughput/latency measurement; AOT artifacts kill them in prod):
+    # one request per prefill bucket in the engine's inventory (a
+    # prompt of bucket+1 tokens prefills exactly one bucket-sized
+    # chunk), which also compiles the decode program
+    for key in eng.program_keys(prompt_lens=[len(p) for p in prompts]):
+        if key[0] != "prefill":
+            continue
+        n = min(int(key[1]) + 1, max_len - 2)
+        eng.generate_batch([rs.randint(0, cfg.vocab_size,
+                                       size=n).tolist()],
+                           max_new_tokens=2)
+
+    # engine latency fields (arrival_t/first_token_t) use time.monotonic,
+    # so the trace clock must too; TTFT is measured against the VIRTUAL
+    # Poisson arrival (t0 + arrivals[i]) — a request whose arrival lands
+    # mid-step is submitted late, and that wait belongs IN its TTFT
+    # (excluding it would flatter exactly the loaded regime this bench
+    # exists to characterize)
+    t0 = time.monotonic()
+    submitted = 0
+    reqs = []
+    while submitted < n_requests or eng.has_work:
+        now = time.monotonic() - t0
+        while submitted < n_requests and arrivals[submitted] <= now:
+            reqs.append(eng.add_request(prompts[submitted],
+                                        max_new_tokens=new_tokens))
+            submitted += 1
+        if eng.has_work:
+            eng.step()
+        elif submitted < n_requests:
+            time.sleep(min(0.001, arrivals[submitted] - now))
+    dt_engine = time.monotonic() - t0
+    gen_tokens = sum(len(r.generated) for r in reqs)
+    ttft = sorted(r.first_token_t - (t0 + arrivals[i])
+                  for i, r in enumerate(reqs))
+    tpot = []
+    for r in reqs:
+        if len(r.generated) > 1:
+            tpot.append((r.last_token_t - r.first_token_t)
+                        / (len(r.generated) - 1))
+    tpot.sort()
+
+    def pct(xs, p):
+        return xs[min(int(p / 100.0 * len(xs)), len(xs) - 1)] if xs else 0
+
+    # --- sequential reference: same trace, one request at a time (jitted
+    # decode; its per-shape programs also warm out of band — one compile
+    # per distinct prompt length, the recompile cost bucketing exists to
+    # avoid, is NOT charged to the sequential path)
+    for n in sorted({len(p) for p in prompts}):
+        jit_generate(model, pt.to_tensor(np.asarray(
+            [prompts[0][:1] * n], "int64")), max_new_tokens=new_tokens)
+    t0 = time.perf_counter()
+    seq_tokens = 0
+    for i, p in enumerate(prompts):
+        now = time.perf_counter() - t0
+        if arrivals[i] > now:
+            time.sleep(arrivals[i] - now)
+        out = jit_generate(model, pt.to_tensor(np.asarray([p], "int64")),
+                           max_new_tokens=new_tokens)
+        seq_tokens += out.shape[1] - len(p)
+    int(out._array[0, -1])
+    dt_seq = time.perf_counter() - t0
+
+    return {"tps": gen_tokens / dt_engine,
+            "seq_tps": seq_tokens / dt_seq,
+            "speedup": (gen_tokens / dt_engine) / (seq_tokens / dt_seq),
+            "requests_s": n_requests / dt_engine,
+            "ttft_p50_s": round(pct(ttft, 50), 4),
+            "ttft_p99_s": round(pct(ttft, 99), 4),
+            "tpot_p50_s": round(pct(tpot, 50), 4),
+            "tpot_p99_s": round(pct(tpot, 99), 4),
+            "n_requests": n_requests, "new_tokens": new_tokens,
+            "preemptions": sum(r.preemptions for r in reqs),
+            "devices": _dev_str()}
+
+
 def _dev_str():
     import jax
     try:
@@ -577,7 +691,8 @@ CHILD_FNS = {"gpt": run_gpt, "resnet": run_resnet, "llama": run_llama,
              "ernie_infer": run_ernie_infer,
              "gpt_decode": run_gpt_decode,
              "gpt_spec_decode": run_gpt_spec_decode,
-             "cold_start": run_cold_start}
+             "cold_start": run_cold_start,
+             "serving": run_serving}
 
 
 def _child_main(spec):
@@ -714,6 +829,28 @@ def main():
     child = os.environ.get("BENCH_CHILD")
     if child:
         _child_main(json.loads(child))
+        return
+
+    if "--serving" in sys.argv:
+        # standalone serving leg (ISSUE 10 acceptance check): runs
+        # in-process on whatever backend jax picked (CPU tier-1 uses a
+        # tiny config so the comparison finishes in seconds) and prints
+        # ONE json line on stdout
+        tiny = os.environ.get("JAX_PLATFORMS", "") == "cpu" or \
+            os.environ.get("BENCH_FORCE_CPU") == "1"
+        kw = dict(preset="gpt3-125M")
+        if tiny:
+            kw = dict(preset="gpt3-125M", hidden_size=64, num_layers=2,
+                      num_heads=4, n_requests=12, arrival_rate=20.0,
+                      prompt_lo=8, prompt_hi=48, new_tokens=16)
+        res = run_serving(**kw)
+        print(json.dumps({
+            "metric": "continuous-batching serving tokens/sec",
+            "value": round(res["tps"], 1),
+            "vs_baseline": round(res["speedup"], 3), **{
+                k: res[k] for k in ("seq_tps", "requests_s", "ttft_p50_s",
+                                    "ttft_p99_s", "tpot_p50_s",
+                                    "tpot_p99_s", "preemptions")}}))
         return
 
     # an external kill (driver timeout sends SIGTERM) must still leave a
@@ -907,6 +1044,25 @@ def main():
                 "value": round(res["tps"], 1), "unit": "tokens/s/chip",
                 "vs_baseline": round(res["speedup"], 3),
                 "token_exact": res["token_exact"]}))
+    if _left() > 400:
+        # serving engine: continuous batching (paddle_tpu/serving) vs
+        # sequential FCFS over the same seeded Poisson trace.
+        # vs_baseline is the aggregate-throughput SPEEDUP; the latency
+        # percentiles ride along in the metric line.
+        res = _spawn({"kind": "serving"}, min(PRESET_TIMEOUT, _left()))
+        if res:
+            record["legs"]["serving"] = res
+            _log(json.dumps({
+                "metric": "GPT-125M continuous-batching serving "
+                          f"tokens/sec/chip (Poisson trace, "
+                          f"{res['n_requests']} reqs, TTFT p50/p99 "
+                          f"{res['ttft_p50_s']}/{res['ttft_p99_s']}s, "
+                          f"TPOT p50/p99 {res['tpot_p50_s']}/"
+                          f"{res['tpot_p99_s']}s)",
+                "value": round(res["tps"], 1), "unit": "tokens/s/chip",
+                "vs_baseline": round(res["speedup"], 3),
+                "sequential_tps": round(res["seq_tps"], 1),
+                "requests_s": round(res["requests_s"], 2)}))
     if _left() > 400:
         # ROADMAP item 4 / PR 7: restart cost with the persistent
         # compile cache.  Two fresh processes share one cache dir: the

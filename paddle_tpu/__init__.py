@@ -49,6 +49,8 @@ from . import incubate  # noqa: F401
 from . import geometric  # noqa: F401
 from . import onnx  # noqa: F401
 from . import inference  # noqa: F401
+from . import serving  # noqa: F401  (after text: engine uses the zoo's
+#                                    generation bucket ladder)
 from . import version  # noqa: F401
 from . import sysconfig  # noqa: F401
 from . import base  # noqa: F401

@@ -44,6 +44,7 @@ def self_lint_targets():
     cands = [os.path.join(root, "paddle_tpu", "vision"),
              os.path.join(root, "paddle_tpu", "text"),
              os.path.join(root, "paddle_tpu", "framework"),
+             os.path.join(root, "paddle_tpu", "serving"),
              os.path.join(root, "paddle_tpu", "tensor_api.py"),
              os.path.join(root, "examples")]
     return [p for p in cands if os.path.exists(p)]

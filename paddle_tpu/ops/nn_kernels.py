@@ -360,6 +360,56 @@ def sdpa_k(q, k, v, mask=None, is_causal=False, scale=None,
     return jnp.einsum("bhlm,bmhd->blhd", probs, v)
 
 
+# --------------------------------------------- paged KV cache (serving)
+@register("paged_write")
+def paged_write_k(pool, val, tables, pos, limit, block_size=16):
+    """Scatter `val` [b, s, H, D] into the paged KV pool [N, bs, H, D]
+    at per-row sequence positions pos[b]..pos[b]+s-1, routed through each
+    row's block table (position p lands in block tables[b, p // bs] at
+    slot p % bs).  Positions >= limit[b] are DROPPED — that one guard
+    covers bucket padding (prefill chunks padded up a shape bucket) and
+    dead decode slots (limit 0 writes nothing), so the pool only ever
+    holds tokens the scheduler accounted for."""
+    bs = int(block_size)
+    s = val.shape[1]
+    positions = (pos.astype(jnp.int32)[:, None]
+                 + jnp.arange(s, dtype=jnp.int32)[None, :])      # [b, s]
+    blk = jnp.take_along_axis(
+        tables.astype(jnp.int32),
+        jnp.clip(positions // bs, 0, tables.shape[1] - 1), axis=1)
+    off = positions % bs
+    # out-of-range block id -> scatter mode="drop" discards the write
+    blk = jnp.where(positions < limit.astype(jnp.int32)[:, None],
+                    blk, pool.shape[0])
+    return pool.at[blk, off].set(val.astype(pool.dtype), mode="drop")
+
+
+@register("paged_attention", amp="allow")
+def paged_attention_k(q, k_pool, v_pool, tables, pos, scale=None):
+    """Decode/prefill attention over the paged KV pool — the jnp `take`
+    reference implementation (the pallas TPU kernel in
+    ops/pallas/paged_attention.py overrides this at import).
+
+    Gathers each row's blocks into a contiguous [b, M*bs, Hkv, D] window
+    and runs the exact `sdpa_k` math under the paged length mask
+    (q row i of a request at context offset pos attends absolute
+    positions <= pos + i), so CPU tier-1 numerics are bit-identical to
+    the dense-cache path."""
+    b, s = q.shape[0], q.shape[1]
+    bs = k_pool.shape[1]
+    m = tables.shape[1]
+    flat = tables.astype(jnp.int32).reshape(-1)
+    K = jnp.take(k_pool, flat, axis=0).reshape(
+        (b, m * bs) + k_pool.shape[2:])
+    V = jnp.take(v_pool, flat, axis=0).reshape(
+        (b, m * bs) + v_pool.shape[2:])
+    cols = jnp.arange(m * bs, dtype=jnp.int32)[None, None, :]
+    rows = (pos.astype(jnp.int32)[:, None, None]
+            + jnp.arange(s, dtype=jnp.int32)[None, :, None])
+    mask = (cols <= rows)[:, None, :, :]                 # [b, 1, s, M*bs]
+    return sdpa_k(q, K, V, mask=mask, scale=scale)
+
+
 # ------------------------------------------------------------------ losses
 @register("softmax_ce", amp="deny")
 def softmax_ce_k(logits, label, soft_label=False, ignore_index=-100,

@@ -17,6 +17,7 @@ import jax
 
 from ..dispatch import get, override
 from . import flash_attention as _fa
+from . import paged_attention as _pa
 
 
 def _mode():
@@ -55,3 +56,21 @@ def sdpa_with_flash(q, k, v, mask=None, is_causal=False, scale=None,
 
 
 override("sdpa", sdpa_with_flash)
+
+
+_xla_paged_attention = get("paged_attention").fn
+
+
+def paged_attention_with_pallas(q, k_pool, v_pool, tables, pos, scale=None):
+    """Serving decode steps stream blocks through the pallas kernel;
+    prefill chunks (s > 1) and unsupported shapes keep the XLA gather
+    fallback, which is also the parity reference."""
+    mode = _mode()
+    if mode is not None and _pa.supports(q.shape, k_pool.shape, q.dtype):
+        return _pa.paged_decode_attention(
+            q, k_pool, v_pool, tables, pos + 1, scale=scale,
+            interpret=(mode == "interpret"))
+    return _xla_paged_attention(q, k_pool, v_pool, tables, pos, scale=scale)
+
+
+override("paged_attention", paged_attention_with_pallas)

@@ -50,6 +50,13 @@ Fault sites (see docs/resilience.md for the full table):
                                 wins must stay torn-free)
     cache.evict_inflight        GC collects a compile-cache entry right
                                 after publish (reader sees a clean miss)
+    serving.pool_exhausted      the serving block pool refuses an
+                                allocation (simulated exhaustion → the
+                                scheduler's preemption path must fire)
+    serving.request_poison      a serving request's logits are ruined
+                                (NaN) — the engine must fail THAT
+                                request and free its blocks without
+                                touching the rest of the batch
 
 Zero-cost when disabled: every site guards on the module-level
 ``_PLAN is None`` check before doing any work.

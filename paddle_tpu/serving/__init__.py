@@ -1,0 +1,33 @@
+"""paddle_tpu.serving — continuous batching over a paged, mesh-sharded
+KV cache (the ROADMAP "millions of users" serving layer).
+
+    block_pool  BlockPool: the per-replica paged KV memory — fixed-size
+                token blocks, per-request block tables, refcounted
+                free list, kv-head axis sharded over the fleet "mp" mesh
+    scheduler   Request lifecycle + the admit/evict/preempt policy
+                (FCFS admission, LIFO recompute preemption, chunked
+                prefill so decode never stalls)
+    engine      LLMEngine: add_request / step / streaming callbacks;
+                ONE static decode program over the pool + one prefill
+                program per shape bucket (PR 7 ladder); TTFT/TPOT/queue
+                percentiles into the PR-2 metrics registry
+    aot         per-bucket AOT artifacts (export/load) for zero-compile
+                warm replica start — the PR 7 follow-up
+
+The decode hot path is the `paged_attention` op: a pallas TPU kernel
+(ops/pallas/paged_attention.py) streaming pool blocks through each
+request's block table, with a jnp gather fallback that keeps CPU tier-1
+numerics bit-identical to the dense cache path.  See docs/serving.md.
+"""
+from __future__ import annotations
+
+from .block_pool import BlockPool, PoolExhausted  # noqa: F401
+from .scheduler import Request, Scheduler  # noqa: F401
+from .engine import LLMEngine  # noqa: F401
+from .aot import (  # noqa: F401
+    export_serving_artifacts, load_serving_artifacts,
+)
+
+__all__ = ["BlockPool", "PoolExhausted", "Request", "Scheduler",
+           "LLMEngine", "export_serving_artifacts",
+           "load_serving_artifacts"]

@@ -1,0 +1,132 @@
+"""Block-paged KV cache pool — one allocation per serving replica.
+
+The pool is the serving engine's only KV memory: per-layer
+[num_blocks, block_size, Hkv, D] arrays allocated ONCE, carved into
+fixed-size token blocks handed to requests through a host-side
+free list with reference counts.  Freed requests return their blocks
+immediately (refcount 0 -> back on the free list), so pool pressure is
+a pure function of live context tokens — the scheduler admits, evicts
+and preempts against `free_blocks`.
+
+Mesh layout: the pool arrays are shaped so the kv-head axis (dim 2) is
+the natural tensor-parallel shard axis — `shard_()` places them as
+PartitionSpec(None, None, "mp", None) on the fleet mesh, the same axis
+the model's ColumnParallel qkv projections shard, so a tensor-parallel
+replica's pool shards with its weights and the paged attention op runs
+on local heads only.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..distributed import mesh as mesh_mod
+from ..resilience import chaos
+
+
+class PoolExhausted(RuntimeError):
+    """A single request needs more blocks than the whole pool holds."""
+
+
+class BlockPool:
+    def __init__(self, num_layers, num_blocks, block_size, num_kv_heads,
+                 head_dim, dtype="float32"):
+        self.num_layers = int(num_layers)
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.num_kv_heads = int(num_kv_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = dtype
+        shape = (self.num_blocks, self.block_size, self.num_kv_heads,
+                 self.head_dim)
+        self.k = [jnp.zeros(shape, dtype=dtype)
+                  for _ in range(self.num_layers)]
+        self.v = [jnp.zeros(shape, dtype=dtype)
+                  for _ in range(self.num_layers)]
+        # host-side allocator: LIFO free list + per-block refcounts
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+        self._refs = [0] * self.num_blocks
+
+    @classmethod
+    def for_model(cls, model, num_blocks, block_size=16, dtype=None):
+        """Size the pool from the model config (kv heads and head_dim
+        follow `new_caches`: GQA models keep unrepeated kv heads)."""
+        cfg = model.cfg
+        hd = cfg.hidden_size // cfg.num_heads
+        hkv = getattr(cfg, "num_kv_heads", None) or cfg.num_heads
+        if dtype is None:
+            dtype = next(iter(model.parameters()))._array.dtype
+        return cls(cfg.num_layers, num_blocks, block_size, hkv, hd,
+                   dtype=dtype)
+
+    # ------------------------------------------------------------ allocator
+    @property
+    def free_blocks(self):
+        return len(self._free)
+
+    @property
+    def used_blocks(self):
+        return self.num_blocks - len(self._free)
+
+    def blocks_for(self, n_tokens):
+        """Blocks needed to hold n_tokens."""
+        return -(-int(n_tokens) // self.block_size)
+
+    def allocate(self, n):
+        """n block ids at refcount 1, or None when the pool can't serve
+        them right now (the scheduler's preemption trigger).  The
+        `serving.pool_exhausted` chaos site simulates that exhaustion."""
+        n = int(n)
+        if n > self.num_blocks:
+            raise PoolExhausted(
+                f"request needs {n} blocks but the whole pool is only "
+                f"{self.num_blocks}; grow num_blocks or cap request "
+                f"lengths")
+        if chaos.fire("serving.pool_exhausted") or n > len(self._free):
+            from ..observability import metrics as _metrics
+            _metrics.registry().counter(
+                "serving_pool_exhausted_total").inc()
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._refs[b] = 1
+        return out
+
+    def ref(self, ids):
+        for b in ids:
+            if self._refs[b] <= 0:
+                raise ValueError(f"ref of unallocated block {b}")
+            self._refs[b] += 1
+
+    def free(self, ids):
+        """Drop one reference per id; blocks at refcount 0 return to the
+        free list immediately."""
+        for b in ids:
+            r = self._refs[b] - 1
+            if r < 0:
+                raise ValueError(f"double free of block {b}")
+            self._refs[b] = r
+            if r == 0:
+                self._free.append(b)
+
+    def check_leaks(self):
+        """(leaked_blocks, bad_refcounts) — both empty when every block
+        is home.  The chaos drill asserts this after an overload run."""
+        leaked = [b for b, r in enumerate(self._refs) if r > 0]
+        bad = [b for b, r in enumerate(self._refs) if r < 0]
+        return leaked, bad
+
+    # ------------------------------------------------------------- sharding
+    def shard_(self):
+        """Lay the pool out on the fleet mesh: kv heads sharded along
+        "mp" (the tensor-parallel axis the qkv projections shard), all
+        other axes replicated.  No-op without a multi-device mp mesh or
+        when heads don't divide it."""
+        if not mesh_mod.has_mesh() or mesh_mod.degree("mp") <= 1:
+            return False
+        if self.num_kv_heads % mesh_mod.degree("mp"):
+            return False
+        import jax
+        sh = mesh_mod.sharding(None, None, "mp", None)
+        self.k = [jax.device_put(a, sh) for a in self.k]
+        self.v = [jax.device_put(a, sh) for a in self.v]
+        return True

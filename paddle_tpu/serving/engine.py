@@ -1,0 +1,403 @@
+"""LLMEngine — continuous (in-flight) batching over the paged KV pool.
+
+The engine owns exactly TWO program shapes, so steady-state serving
+never recompiles:
+
+* **one decode program** over the whole pool: [max_running] static
+  request slots, each consuming one token through its block table
+  (dead slots ride along with write-limit 0);
+* **one prefill program per shape bucket** (PR 7's ladder —
+  `generation.BucketPolicy`): a prompt chunk padded up a bucket streams
+  its K/V into the pool; the lm_head matmul is dead code XLA prunes,
+  so prefill pays attention+MLP only.
+
+`step()` is one scheduler iteration: admit → bounded prefill chunking →
+one batched decode step → sample/stream/finish.  Long prompts therefore
+chunk across many steps while every decode-ready request still advances
+one token per step — prefill never stalls in-flight decode.
+
+Token parity: with greedy sampling the engine's per-request output is
+token-identical to a sequential `generation.generate` call — decode
+attends gathered pool blocks with the exact `sdpa` math (see
+`paged_attention` in ops/nn_kernels.py), and tests/test_serving.py
+asserts the equality under concurrent interleaved requests.
+
+Per-request latency telemetry (TTFT/TPOT/queue-wait percentiles, pool
+and queue gauges) flows into the PR-2 metrics registry; see
+docs/serving.md for the full table.
+"""
+from __future__ import annotations
+
+import time
+import warnings
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..autograd import engine as _autograd
+from ..jit import functional_bridge as FB
+from ..observability import metrics as _metrics
+from ..resilience import chaos
+from ..tensor import Tensor
+from ..text.generation import BucketPolicy
+from .block_pool import BlockPool, PoolExhausted
+from .scheduler import RUNNING, Request, Scheduler
+
+
+class LLMEngine:
+    def __init__(self, model, num_blocks=64, block_size=16, max_running=8,
+                 prefill_chunk=64, buckets=None, max_model_len=None,
+                 dtype=None):
+        if getattr(getattr(model, "cfg", None), "sliding_window", None):
+            raise NotImplementedError(
+                "sliding_window models cannot serve from the paged pool "
+                "yet (the pool keeps the full context)")
+        self.model = model
+        model.eval()
+        self.pool = BlockPool.for_model(model, num_blocks,
+                                        block_size=block_size, dtype=dtype)
+        self.pool.shard_()
+        self.scheduler = Scheduler(self.pool, max_running=max_running)
+        self.max_running = int(max_running)
+        self.prefill_chunk = int(prefill_chunk)
+        self.policy = buckets if isinstance(buckets, BucketPolicy) \
+            else BucketPolicy(buckets=buckets)
+        max_pos = getattr(model.cfg, "max_position_embeddings", None)
+        self.max_model_len = int(max_model_len or max_pos
+                                 or num_blocks * block_size)
+        if max_pos is not None:
+            self.max_model_len = min(self.max_model_len, int(max_pos))
+        self.table_cols = self.pool.blocks_for(self.max_model_len)
+
+        self._pn, self._p_arrays, self._bn, self._b_arrays = \
+            FB.split_state(model)
+        self._programs = {}     # key -> live jitted program
+        self._aot_execs = {}    # key -> deserialized AOT executable
+        self._finished = []
+        self._reg = _metrics.registry()
+
+    # ------------------------------------------------------------- requests
+    def add_request(self, prompt_ids, max_new_tokens=20, eos_token_id=None,
+                    do_sample=False, temperature=1.0, top_k=None,
+                    top_p=None, seed=0, on_token=None, on_finish=None):
+        """Queue a request; returns the Request handle (its `generated`
+        list fills in as `step()` runs; `on_token(req, tok)` streams)."""
+        prompt = np.asarray(prompt_ids).reshape(-1).astype(np.int64)
+        if len(prompt) < 1:
+            raise ValueError("empty prompt")
+        total = len(prompt) + int(max_new_tokens)
+        if total > self.max_model_len:
+            raise ValueError(
+                f"request needs {total} positions but the replica serves "
+                f"max_model_len={self.max_model_len}")
+        if self.pool.blocks_for(total) > self.pool.num_blocks:
+            raise PoolExhausted(
+                f"request needs {self.pool.blocks_for(total)} blocks; "
+                f"pool has {self.pool.num_blocks} total")
+        req = Request(prompt, max_new_tokens=max_new_tokens,
+                      eos_token_id=eos_token_id, do_sample=do_sample,
+                      temperature=temperature, top_k=top_k, top_p=top_p,
+                      seed=seed, on_token=on_token, on_finish=on_finish)
+        if chaos.fire("serving.request_poison", tag=req.id):
+            req.poisoned = True
+        self.scheduler.submit(req)
+        self._reg.counter("serving_requests_submitted_total").inc()
+        return req
+
+    @property
+    def has_work(self):
+        return bool(self.scheduler.waiting or self.scheduler.running)
+
+    def run(self, max_steps=None):
+        """Drive step() until the queues drain (or max_steps)."""
+        n = 0
+        while self.has_work and (max_steps is None or n < max_steps):
+            self.step()
+            n += 1
+        return n
+
+    def generate_batch(self, prompts, max_new_tokens=20, **kw):
+        """Convenience: submit every prompt, drain, return the generated
+        token lists in submission order."""
+        reqs = [self.add_request(p, max_new_tokens=max_new_tokens, **kw)
+                for p in prompts]
+        self.run()
+        return [list(r.generated) for r in reqs]
+
+    # ----------------------------------------------------------------- step
+    def step(self):
+        """One continuous-batching iteration.  Returns a summary dict."""
+        sched = self.scheduler
+        now = time.monotonic()
+        admitted = sched.admit()
+        for req in admitted:
+            self._reg.counter("serving_requests_admitted_total").inc()
+            self._reg.histogram("serving_queue_wait_seconds").observe(
+                now - req.arrival_t)
+
+        # ---- prefill lane: a bounded token budget per step
+        budget = self.prefill_chunk
+        for req in list(sched.running):
+            if budget <= 0:
+                break
+            if not req.needs_prefill:
+                continue
+            n = min(budget, req.feed_len - 1 - req.ctx)
+            self._prefill(req, n)
+            budget -= n
+
+        # ---- decode lane: every decode-ready request advances one token
+        ready = []
+        for req in [r for r in sched.running if r.decode_ready]:
+            if req.state != RUNNING:
+                continue            # a victim of an earlier grow()
+            if sched.grow(req):
+                ready.append(req)
+        ready = [r for r in ready if r.state == RUNNING]
+        # ready ⊆ running and admit() caps running at max_running, so
+        # the static decode program always has a slot for every row
+        assert len(ready) <= self.max_running
+        if ready:
+            self._decode(ready)
+
+        self._reg.gauge("serving_queue_depth").set(sched.queue_depth)
+        self._reg.gauge("serving_running_requests").set(len(sched.running))
+        self._reg.gauge("serving_free_blocks").set(self.pool.free_blocks)
+        return {"admitted": len(admitted), "decoded": len(ready),
+                "running": len(sched.running),
+                "waiting": sched.queue_depth}
+
+    # ------------------------------------------------------------- programs
+    def retire_aot(self, key=None):
+        """Drop loaded AOT executables (all, or one key) so the next call
+        compiles the donating live program.  AOT artifacts are serialized
+        ALIAS-FREE (serving.aot), so on donating backends a warm-started
+        replica copies the pool every step until the bridge is retired —
+        call this at a quiet moment once the replica is warm.  Returns
+        the retired keys."""
+        keys = [key] if key is not None else list(self._aot_execs)
+        for k in keys:
+            self._aot_execs.pop(k, None)
+        return keys
+
+    def _run_program(self, key, builder, *args):
+        fn = self._aot_execs.get(key)
+        if fn is not None:
+            try:
+                return fn(*args)
+            except TypeError as e:
+                warnings.warn(
+                    f"serving AOT executable {key} rejected this call "
+                    f"({e}); falling back to live jit", UserWarning,
+                    stacklevel=2)
+                del self._aot_execs[key]
+        jit_fn = self._programs.get(key)
+        if jit_fn is None:
+            jit_fn = self._programs[key] = builder()
+        return jit_fn(*args)
+
+    @staticmethod
+    def _donate_pools():
+        """Donate the pool buffers through the live decode/prefill
+        programs (they are pure pool -> pool updates, and the engine
+        drops its old references right after the call) — without
+        donation every step copies the whole pool per layer.  CPU can't
+        alias donated buffers (jax warns and copies anyway), and AOT
+        export must stay alias-free (deserialized alias-baked
+        executables are the PR-7 segfault class) — both get the
+        non-donating build."""
+        return jax.default_backend() != "cpu"
+
+    def _build_decode(self, donate=None):
+        model, pn, bn = self.model, self._pn, self._bn
+        nl = self.pool.num_layers
+
+        def pure(p_arrays, b_arrays, ks, vs, tables, pos, tokens, limit):
+            caches = [{"k": Tensor._from_array(ks[i]),
+                       "v": Tensor._from_array(vs[i]),
+                       "table": Tensor._from_array(tables),
+                       "pos": Tensor._from_array(pos),
+                       "limit": Tensor._from_array(limit)}
+                      for i in range(nl)]
+            with FB._swapped(model, pn, p_arrays, bn, b_arrays):
+                with _autograd.no_grad():
+                    logits = model(Tensor._from_array(tokens[:, None]),
+                                   caches=caches)
+            new_ks = [c["k"]._array for c in caches]
+            new_vs = [c["v"]._array for c in caches]
+            return (logits._array[:, -1, :].astype(jnp.float32),
+                    new_ks, new_vs)
+
+        donate = self._donate_pools() if donate is None else donate
+        return jax.jit(pure, donate_argnums=(2, 3) if donate else ())
+
+    def _build_prefill(self, donate=None):
+        model, pn, bn = self.model, self._pn, self._bn
+        nl = self.pool.num_layers
+
+        def pure(p_arrays, b_arrays, ks, vs, table, pos, tokens, limit):
+            caches = [{"k": Tensor._from_array(ks[i]),
+                       "v": Tensor._from_array(vs[i]),
+                       "table": Tensor._from_array(table),
+                       "pos": Tensor._from_array(pos),
+                       "limit": Tensor._from_array(limit)}
+                      for i in range(nl)]
+            with FB._swapped(model, pn, p_arrays, bn, b_arrays):
+                with _autograd.no_grad():
+                    model(Tensor._from_array(tokens), caches=caches)
+            # only the written pools leave the program: the lm_head
+            # matmul (and every logit) is dead code XLA prunes, so a
+            # prefill chunk costs attention+MLP only
+            return ([c["k"]._array for c in caches],
+                    [c["v"]._array for c in caches])
+
+        donate = self._donate_pools() if donate is None else donate
+        return jax.jit(pure, donate_argnums=(2, 3) if donate else ())
+
+    def program_keys(self, prompt_lens=()):
+        """The program inventory a replica needs: the decode program
+        plus one prefill program per ladder bucket up to the chunk
+        bucket.  The WHOLE sub-ladder is included — the prefill lane
+        splits one per-step token budget across concurrently-admitted
+        requests, so live chunk sizes (and therefore buckets) below
+        `prefill_chunk` all occur regardless of prompt lengths;
+        `prompt_lens` is kept for callers that want to assert coverage
+        of specific workloads (chunks never exceed the budget, so it
+        can only add buckets already in the ladder)."""
+        cap = self.policy.bucket(self.prefill_chunk)
+        buckets, n = set(), 1
+        while True:
+            b = self.policy.bucket(n)
+            buckets.add(b)
+            if b >= cap:
+                break
+            n = b + 1
+        for n in prompt_lens:
+            buckets.add(self.policy.bucket(
+                min(max(int(n) - 1, 1), self.prefill_chunk)))
+        return [("decode",)] + sorted(("prefill", b) for b in buckets)
+
+    def program_structs(self, key):
+        """(builder, example ShapeDtypeStructs) for AOT lowering.  The
+        builder produces the ALIAS-FREE (non-donating) build — serialized
+        alias-baked executables are the PR-7 segfault class."""
+        import functools
+        s = jax.ShapeDtypeStruct
+        p = [s(a.shape, a.dtype) for a in self._p_arrays]
+        b = [s(a.shape, a.dtype) for a in self._b_arrays]
+        ks = [s(a.shape, a.dtype) for a in self.pool.k]
+        vs = [s(a.shape, a.dtype) for a in self.pool.v]
+        i32 = np.int32
+        if key[0] == "decode":
+            R, M = self.max_running, self.table_cols
+            return functools.partial(self._build_decode, donate=False), (
+                p, b, ks, vs, s((R, M), i32), s((R,), i32), s((R,), i32),
+                s((R,), i32))
+        if key[0] == "prefill":
+            Lb = int(key[1])
+            return functools.partial(self._build_prefill, donate=False), (
+                p, b, ks, vs, s((1, self.table_cols), i32), s((1,), i32),
+                s((1, Lb), i32), s((1,), i32))
+        raise KeyError(f"unknown serving program key {key!r}")
+
+    # ------------------------------------------------------------- prefill
+    def _prefill(self, req, n):
+        bucket = self.policy.bucket(n)
+        feed = req.feed_tokens()
+        chunk = feed[req.ctx:req.ctx + n]
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n] = chunk
+        table = np.zeros((1, self.table_cols), np.int32)
+        table[0, :len(req.block_table)] = req.block_table
+        pos = np.asarray([req.ctx], np.int32)
+        limit = np.asarray([req.ctx + n], np.int32)
+        ks, vs = self._run_program(
+            ("prefill", bucket), self._build_prefill,
+            self._p_arrays, self._b_arrays, self.pool.k, self.pool.v,
+            table, pos, tokens, limit)
+        self.pool.k, self.pool.v = list(ks), list(vs)
+        req.ctx += n
+        self._reg.counter("serving_prefill_tokens_total").inc(n)
+
+    # -------------------------------------------------------------- decode
+    def _decode(self, ready):
+        R, M = self.max_running, self.table_cols
+        tables = np.zeros((R, M), np.int32)
+        pos = np.zeros(R, np.int32)
+        tokens = np.zeros(R, np.int32)
+        limit = np.zeros(R, np.int32)    # 0 = dead slot, writes dropped
+        for i, req in enumerate(ready):
+            tables[i, :len(req.block_table)] = req.block_table
+            pos[i] = req.ctx
+            tokens[i] = req.feed_tokens()[req.ctx]
+            limit[i] = req.ctx + 1
+        logits, ks, vs = self._run_program(
+            ("decode",), self._build_decode,
+            self._p_arrays, self._b_arrays, self.pool.k, self.pool.v,
+            tables, pos, tokens, limit)
+        self.pool.k, self.pool.v = list(ks), list(vs)
+        rows = np.asarray(logits)
+        now = time.monotonic()
+        self._reg.counter("serving_decode_steps_total").inc()
+        self._reg.histogram("serving_decode_batch").observe(len(ready))
+        for i, req in enumerate(ready):
+            req.ctx += 1
+            self._emit(req, rows[i], now)
+
+    def _emit(self, req, logits_row, now):
+        if req.poisoned:
+            # chaos serving.request_poison: this request's logits are
+            # ruined; the guard below must fail IT without touching the
+            # rest of the batch
+            logits_row = np.full_like(logits_row, np.nan)
+        if not np.isfinite(logits_row).all():
+            self._finish(req, "error")
+            return
+        tok = _sample_row(req, logits_row)
+        req.generated.append(tok)
+        if req.first_token_t is None:
+            req.first_token_t = now
+            self._reg.histogram("serving_ttft_seconds").observe(
+                now - req.arrival_t)
+        elif req.last_token_t is not None:
+            self._reg.histogram("serving_tpot_seconds").observe(
+                now - req.last_token_t)
+        req.last_token_t = now
+        self._reg.counter("serving_tokens_generated_total").inc()
+        if req.on_token is not None:
+            req.on_token(req, tok)
+        if req.eos_token_id is not None and tok == req.eos_token_id:
+            self._finish(req, "eos")
+        elif len(req.generated) >= req.max_new_tokens:
+            self._finish(req, "length")
+
+    def _finish(self, req, reason):
+        self.scheduler.finish(req, reason)
+        self._finished.append(req)
+        name = ("serving_requests_failed_total" if reason == "error"
+                else "serving_requests_finished_total")
+        self._reg.counter(name).inc()
+        if req.on_finish is not None:
+            req.on_finish(req)
+
+
+def _sample_row(req, logits_row):
+    """Host-side sampling from one fp32 logits row.  Greedy is
+    np.argmax — token-identical to the sequential generate() path;
+    sampled mode filters through the ONE `generation.filter_logits`
+    implementation (so temperature/top-k/top-p semantics can never
+    drift from generate()) but draws from a per-request seeded numpy
+    Generator — a deterministic stream per (prompt, seed), independent
+    of batch composition, unlike sharing one jax key across the whole
+    batch."""
+    if not req.do_sample:
+        return int(np.argmax(logits_row))
+    if req._rng is None:
+        req._rng = np.random.default_rng(req.seed)
+    from ..text.generation import filter_logits
+    filtered = filter_logits(jnp.asarray(logits_row)[None, :],
+                             req.temperature, req.top_k, req.top_p)[0]
+    p = np.asarray(jax.nn.softmax(filtered), dtype=np.float64)
+    p = p / p.sum()      # exact renormalization for rng.choice
+    return int(req._rng.choice(len(p), p=p))

@@ -1,0 +1,179 @@
+"""Request lifecycle + continuous-batching scheduler.
+
+Policy (vLLM-style, adapted to the static-slot decode program):
+
+* **Admission** is FCFS from the waiting deque: a request is admitted
+  when a decode slot is open and the pool can hand it blocks for its
+  whole current prefix (prompt + any tokens generated before a
+  preemption) plus the first decode token.  Preempted requests rejoin
+  the FRONT of the queue, so an eviction never costs a request its
+  place in line.
+* **Preemption** is LIFO — when a running request needs one more block
+  and the pool is dry, the YOUNGEST other running request is evicted
+  (recompute-style: its blocks are freed now, its prefix re-prefills on
+  readmission).  Oldest-first eviction would starve the head of the
+  line; evicting the youngest bounds any request's preemption count by
+  the pool's churn, which is the fairness half of the admission story.
+* **Prefill/decode split**: prefill happens in bounded chunks
+  (`prefill_chunk` tokens per engine step), so a long prompt occupies
+  the prefill lane for many steps while every decode-ready request
+  still advances one token per step — in-flight decode never stalls
+  behind admission.
+"""
+from __future__ import annotations
+
+import collections
+import time
+
+WAITING = "waiting"
+RUNNING = "running"
+PREEMPTED = "preempted"
+FINISHED = "finished"
+FAILED = "failed"
+
+
+class Request:
+    """One generation request moving through the engine."""
+
+    _next_id = 0
+
+    def __init__(self, prompt_ids, max_new_tokens=20, eos_token_id=None,
+                 do_sample=False, temperature=1.0, top_k=None, top_p=None,
+                 seed=0, on_token=None, on_finish=None):
+        self.id = Request._next_id
+        Request._next_id += 1
+        self.prompt = [int(t) for t in prompt_ids]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.do_sample = bool(do_sample)
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.top_p = top_p
+        self.seed = int(seed)
+        self.on_token = on_token
+        self.on_finish = on_finish
+
+        self.state = WAITING
+        self.generated = []         # emitted token ids
+        self.block_table = []       # pool block ids, position-ordered
+        self.ctx = 0                # tokens whose K/V live in the pool
+        self.finish_reason = None
+        self.poisoned = False       # chaos serving.request_poison
+        self.preemptions = 0
+        self._rng = None            # lazy np.random.Generator (sampling)
+
+        self.arrival_t = time.monotonic()
+        self.first_token_t = None
+        self.last_token_t = None
+
+    # `feed` = every token the model must consume: the prompt plus all
+    # generated tokens.  Invariant: `ctx` tokens have K/V in the pool;
+    # feed[ctx] is the next input.  Prefill streams feed[0:feed_len-1]
+    # into the pool in chunks; the decode step then consumes feed[ctx]
+    # (the last prompt token on a fresh request, the newest generated
+    # token afterwards), writes its K/V, and samples the next token —
+    # ONE uniform decode path does all sampling.
+    @property
+    def feed_len(self):
+        return len(self.prompt) + len(self.generated)
+
+    @property
+    def decode_ready(self):
+        return self.state == RUNNING and self.ctx == self.feed_len - 1
+
+    @property
+    def needs_prefill(self):
+        """True while part of the prefix still has to stream into the
+        pool (fresh admission, or re-prefill after preemption)."""
+        return self.state == RUNNING and self.ctx < self.feed_len - 1
+
+    def feed_tokens(self):
+        return self.prompt + self.generated
+
+    def __repr__(self):
+        return (f"Request(id={self.id}, state={self.state}, "
+                f"prompt={len(self.prompt)}, gen={len(self.generated)}, "
+                f"ctx={self.ctx})")
+
+
+class Scheduler:
+    """Admission / eviction / preemption against the block pool."""
+
+    def __init__(self, pool, max_running=8):
+        self.pool = pool
+        self.max_running = int(max_running)
+        self.waiting = collections.deque()
+        self.running = []           # admission-ordered (oldest first)
+
+    @property
+    def queue_depth(self):
+        return len(self.waiting)
+
+    def submit(self, req):
+        req.state = WAITING
+        self.waiting.append(req)
+
+    def admit(self):
+        """Move waiting requests into the running set while slots and
+        blocks last.  Returns the newly admitted requests."""
+        admitted = []
+        while self.waiting and len(self.running) < self.max_running:
+            req = self.waiting[0]
+            # blocks for the whole prefix to re/prefill plus one decode
+            # token, so admission can't strand a request mid-prefill
+            need = self.pool.blocks_for(req.feed_len + 1)
+            blocks = self.pool.allocate(need)
+            if blocks is None:
+                break               # head-of-line blocks: stay FCFS
+            self.waiting.popleft()
+            req.block_table = blocks
+            req.ctx = 0
+            req.state = RUNNING
+            self.running.append(req)
+            admitted.append(req)
+        return admitted
+
+    def grow(self, req):
+        """Ensure `req` has a block for its next token; preempts the
+        youngest OTHER running request when the pool is dry.  Returns
+        False when no space could be made (req should retry next step)."""
+        need_blocks = self.pool.blocks_for(req.feed_len)
+        while len(req.block_table) < need_blocks:
+            got = self.pool.allocate(1)
+            if got is not None:
+                req.block_table.extend(got)
+                continue
+            victim = self._pick_victim(exclude=req)
+            if victim is None:
+                return False
+            self.preempt(victim)
+        return True
+
+    def _pick_victim(self, exclude):
+        for cand in reversed(self.running):      # youngest admission last
+            if cand is not exclude:
+                return cand
+        return None
+
+    def preempt(self, req):
+        """Evict: free every block now, requeue at the FRONT; the prefix
+        (prompt + generated so far) re-prefills on readmission."""
+        from ..observability import metrics as _metrics
+        _metrics.registry().counter(
+            "serving_requests_preempted_total").inc()
+        self.pool.free(req.block_table)
+        req.block_table = []
+        req.ctx = 0
+        req.preemptions += 1
+        req.state = PREEMPTED
+        self.running.remove(req)
+        self.waiting.appendleft(req)
+
+    def finish(self, req, reason):
+        if req.block_table:
+            self.pool.free(req.block_table)
+            req.block_table = []
+        req.state = FAILED if reason == "error" else FINISHED
+        req.finish_reason = reason
+        if req in self.running:
+            self.running.remove(req)

@@ -63,6 +63,24 @@ def _update_prealloc_cache(cache, k, v, s, window=None):
     return K, V, mask
 
 
+def _update_paged_cache(cache, k, v):
+    """Serving path: write k/v [b, s, H, D] into the block-paged pool at
+    each row's context offset and return (k_pool, v_pool) for the paged
+    attention op.  The cache dict carries the pool view the engine
+    assembled for this step: {"k"/"v": [N, bs, Hkv, D] pool Tensors,
+    "table": [b, M] block ids, "pos": [b] context offsets, "limit": [b]
+    write ceilings (pos + real chunk length; 0 for dead decode slots)}.
+    Like `_update_prealloc_cache` this is write-THEN-attend: the current
+    chunk's keys are visible to its own queries."""
+    from ..ops import call as ops_call
+    bs = cache["k"].shape[1]
+    cache["k"] = ops_call("paged_write", cache["k"], k, cache["table"],
+                          cache["pos"], cache["limit"], block_size=bs)
+    cache["v"] = ops_call("paged_write", cache["v"], v, cache["table"],
+                          cache["pos"], cache["limit"], block_size=bs)
+    return cache["k"], cache["v"]
+
+
 def _sample(logits, key, do_sample, temperature, top_k, top_p):
     from .generation import filter_logits
     logits = logits.astype(jnp.float32)

@@ -190,7 +190,13 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
                 next_tok = _sample_next(
                     logits._array[:, -1, :].astype(jnp.float32),
                     temperature, top_k, top_p, greedy=not do_sample)
-                out.append(np.asarray(next_tok)[:, None])
+                nxt = np.asarray(next_tok)[:, None]
+                if eos_token_id is not None:
+                    # per-sequence stop: a finished row emits eos padding
+                    # (right-aligned) instead of sampling garbage past
+                    # its eos — matching the jitted loop's eos-fill
+                    nxt = np.where(finished[:, None], eos_token_id, nxt)
+                out.append(nxt)
             return Tensor(np.concatenate(out, axis=1))
     finally:
         if was_training:
@@ -277,7 +283,12 @@ def _bucketed_generate(model, input_ids, max_new_tokens, do_sample,
         next_tok = _sample_next(
             logits._array[:, -1, :].astype(jnp.float32),
             temperature, top_k, top_p, greedy=not do_sample)
-        out.append(np.asarray(next_tok)[:, None])
+        nxt = np.asarray(next_tok)[:, None]
+        if eos_token_id is not None:
+            # per-sequence stop: finished rows emit eos padding (see the
+            # unbucketed loop) — the two paths stay token-identical
+            nxt = np.where(finished[:, None], eos_token_id, nxt)
+        out.append(nxt)
     return Tensor(np.concatenate(out, axis=1))
 
 

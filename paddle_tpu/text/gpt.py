@@ -116,7 +116,17 @@ class GPTAttention(nn.Layer):
         qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads,
                                         self.head_dim])
         q, k, v = qkv.unbind(axis=2)
-        if cache is not None and "pos" in cache:
+        if cache is not None and "table" in cache:
+            # block-paged pool (serving engine): write this chunk's k/v
+            # through the block table, then attend the whole context via
+            # the paged attention op (pallas kernel on TPU, jnp gather
+            # fallback elsewhere)
+            from .decode import _update_paged_cache
+            from ..ops import call as ops_call
+            kp, vp = _update_paged_cache(cache, k, v)
+            out = ops_call("paged_attention", q, kp, vp, cache["table"],
+                           cache["pos"])
+        elif cache is not None and "pos" in cache:
             # preallocated cache (jitted decode): static shapes, write at
             # the traced offset, attend under a length mask
             k, v, mask = _update_prealloc_cache(cache, k, v, s)

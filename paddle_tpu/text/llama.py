@@ -156,6 +156,23 @@ class LlamaAttention(nn.Layer):
 
         mask = None
         W = cfg.sliding_window
+        paged = cache is not None and "table" in cache
+        if paged:
+            # block-paged pool (serving engine): write-then-attend via
+            # the paged attention op; GQA kv heads stay unrepeated (the
+            # pallas kernel groups via its kv index map, the fallback
+            # repeats inside sdpa_k)
+            if W:
+                raise NotImplementedError(
+                    "sliding_window does not compose with the paged "
+                    "serving cache (the pool keeps the full context); "
+                    "serve this model without paged attention")
+            from .decode import _update_paged_cache
+            from ..ops import call as ops_call
+            kp, vp = _update_paged_cache(cache, k, v)
+            out = ops_call("paged_attention", q, kp, vp, cache["table"],
+                           cache["pos"])
+            return self.o_proj(out.reshape([b, s, -1]))
         if prealloc:
             from .decode import _update_prealloc_cache
             k, v, mask = _update_prealloc_cache(cache, k, v, s, window=W)

@@ -1,0 +1,342 @@
+"""Continuous-batching serving engine (paddle_tpu/serving).
+
+The load-bearing property: engine output under CONCURRENT interleaved
+requests is token-identical to sequential `generate()` per request —
+paged attention over gathered pool blocks runs the exact dense-cache
+sdpa math, so batching/chunking/preemption may never change a token.
+Plus: block-pool alloc/free/refcount invariants, preemption-and-resume
+mid-decode, pallas-vs-fallback paged attention equivalence, AOT
+round-trip, and the chaos overload drill (tier-1 wiring of
+``chaos_check --serving``).
+"""
+import io
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.serving import (BlockPool, LLMEngine, PoolExhausted,
+                                export_serving_artifacts,
+                                load_serving_artifacts)
+from paddle_tpu.text import (GPTConfig, GPTForCausalLM, LlamaConfig,
+                             LlamaForCausalLM)
+from paddle_tpu.text.generation import generate
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tiny_gpt():
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, max_position_embeddings=64,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    tensor_parallel=False)
+    return GPTForCausalLM(cfg)
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    return _tiny_gpt()
+
+
+@pytest.fixture(scope="module")
+def gpt_engine(gpt):
+    """One shared engine (its compiled programs amortize across tests;
+    every test drains its requests, so state resets between them)."""
+    return LLMEngine(gpt, num_blocks=48, block_size=8, max_running=9,
+                     prefill_chunk=16)
+
+
+def _tiny_llama():
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                      num_heads=4, num_kv_heads=2, intermediate_size=64,
+                      max_position_embeddings=64, tensor_parallel=False)
+    return LlamaForCausalLM(cfg)
+
+
+def _seq_ref(model, prompt, n, eos=None):
+    out = generate(model, pt.to_tensor(np.asarray([prompt], "int64")),
+                   max_new_tokens=n, eos_token_id=eos)
+    return out.numpy()[0, len(prompt):].tolist()
+
+
+# ===================================================================
+# token parity under concurrent interleaved load (the acceptance bar:
+# >= 8 concurrent requests of mixed prompt lengths)
+# ===================================================================
+def test_engine_parity_concurrent_interleaved(gpt, gpt_engine):
+    m, eng = gpt, gpt_engine
+    rng = np.random.RandomState(0)
+    lens = (5, 11, 3, 9, 14, 7, 4, 12, 6)
+    prompts = [rng.randint(0, 64, size=n).tolist() for n in lens]
+    refs = [_seq_ref(m, p, 7) for p in prompts]
+
+    # interleave arrivals with decoding: the first wave is mid-flight
+    # when the rest join the batch
+    reqs = [eng.add_request(p, max_new_tokens=7) for p in prompts[:5]]
+    for _ in range(3):
+        eng.step()
+    reqs += [eng.add_request(p, max_new_tokens=7) for p in prompts[5:]]
+    eng.run()
+    outs = [list(r.generated) for r in reqs]
+    assert outs == refs
+    leaked, bad = eng.pool.check_leaks()
+    assert not leaked and not bad
+    assert eng.pool.free_blocks == eng.pool.num_blocks
+
+
+def test_engine_parity_llama_gqa():
+    m = _tiny_llama()
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, 64, size=n).tolist() for n in (6, 10, 4)]
+    refs = [_seq_ref(m, p, 5) for p in prompts]
+    eng = LLMEngine(m, num_blocks=24, block_size=8, max_running=4)
+    assert eng.generate_batch(prompts, max_new_tokens=5) == refs
+
+
+def test_engine_eos_stops_request(gpt, gpt_engine):
+    prompt = [1, 2, 3, 4, 5]
+    first = _seq_ref(gpt, prompt, 1)[0]
+    ref = _seq_ref(gpt, prompt, 6, eos=first)
+    [out] = gpt_engine.generate_batch([prompt], max_new_tokens=6,
+                                      eos_token_id=first)
+    assert out == ref
+    assert gpt_engine._finished[-1].finish_reason == "eos"
+    assert len(out) < 6
+
+
+def test_streaming_callbacks_order(gpt_engine):
+    got, done = [], []
+    req = gpt_engine.add_request([3, 1, 4, 1, 5], max_new_tokens=5,
+                                 on_token=lambda r, t: got.append(t),
+                                 on_finish=lambda r: done.append(r.id))
+    gpt_engine.run()
+    assert got == list(req.generated) and len(got) == 5
+    assert done == [req.id]
+
+
+def test_sampled_requests_deterministic_per_seed(gpt_engine):
+    prompts = [[5, 6, 7], [9, 8, 7, 6]]
+    kw = dict(max_new_tokens=6, do_sample=True, temperature=0.9,
+              top_k=20, seed=123)
+    a = gpt_engine.generate_batch(prompts, **kw)
+    b = gpt_engine.generate_batch(list(reversed(prompts)), **kw)
+    # per-request numpy stream: independent of batch order/composition
+    assert a == list(reversed(b))
+
+
+# ===================================================================
+# block pool invariants
+# ===================================================================
+def test_block_pool_alloc_free_refcount():
+    pool = BlockPool(num_layers=1, num_blocks=8, block_size=4,
+                     num_kv_heads=2, head_dim=8)
+    a = pool.allocate(3)
+    assert len(a) == 3 and pool.free_blocks == 5
+    pool.ref(a)                       # rc 2
+    pool.free(a)                      # rc 1 — still held
+    assert pool.free_blocks == 5
+    pool.free(a)                      # rc 0 — home
+    assert pool.free_blocks == 8
+    with pytest.raises(ValueError):
+        pool.free(a)                  # double free
+    b = pool.allocate(8)
+    assert pool.allocate(1) is None   # exhausted -> None, not a raise
+    with pytest.raises(PoolExhausted):
+        pool.allocate(9)              # can never fit -> hard error
+    pool.free(b)
+    assert pool.check_leaks() == ([], [])
+    with pytest.raises(ValueError):
+        pool.ref([0])                 # ref of an unallocated block
+
+
+def test_block_pool_blocks_for():
+    pool = BlockPool(1, 8, 16, 2, 8)
+    assert [pool.blocks_for(n) for n in (1, 16, 17, 32)] == [1, 1, 2, 2]
+
+
+# ===================================================================
+# preemption and resume mid-decode
+# ===================================================================
+def test_preemption_resume_mid_decode_parity(gpt):
+    m = gpt
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(0, 64, size=n).tolist()
+               for n in (7, 11, 5, 9, 6, 4)]
+    refs = [_seq_ref(m, p, 8) for p in prompts]
+    # 6 blocks of 4 tokens cannot hold 6 requests of 12-19 tokens:
+    # preemption MUST fire, and evicted requests re-prefill + resume
+    eng = LLMEngine(m, num_blocks=6, block_size=4, max_running=6,
+                    prefill_chunk=8)
+    reqs = [eng.add_request(p, max_new_tokens=8) for p in prompts]
+    eng.run()
+    assert sum(r.preemptions for r in reqs) >= 1
+    assert [list(r.generated) for r in reqs] == refs
+    assert eng.pool.free_blocks == eng.pool.num_blocks
+
+
+def test_preempted_request_keeps_queue_front(gpt):
+    eng = LLMEngine(gpt, num_blocks=4, block_size=4, max_running=2,
+                    prefill_chunk=8)
+    a = eng.add_request([1] * 9, max_new_tokens=6)
+    b = eng.add_request([2] * 9, max_new_tokens=6)
+    eng.run()
+    assert a.finish_reason == "length" and b.finish_reason == "length"
+    leaked, bad = eng.pool.check_leaks()
+    assert not leaked and not bad
+
+
+# ===================================================================
+# paged attention: pallas (interpret) vs the jnp gather fallback
+# ===================================================================
+def test_paged_attention_pallas_matches_fallback():
+    import jax.numpy as jnp
+    from paddle_tpu.ops.nn_kernels import paged_attention_k
+    from paddle_tpu.ops.pallas import paged_attention as pa
+
+    rng = np.random.RandomState(0)
+    # D = 128: the kernel serves lane-aligned head dims only (the pool
+    # is never padded in-call; others take the gather fallback)
+    B, H, Hkv, D, bs, N, M = 3, 4, 2, 128, 8, 12, 4
+    q = jnp.asarray(rng.randn(B, 1, H, D), jnp.float32)
+    kp = jnp.asarray(rng.randn(N, bs, Hkv, D), jnp.float32)
+    vp = jnp.asarray(rng.randn(N, bs, Hkv, D), jnp.float32)
+    tables = jnp.asarray(rng.permutation(N)[:B * M].reshape(B, M),
+                         jnp.int32)
+    pos = jnp.asarray([5, 17, 30], jnp.int32)
+    assert pa.supports(q.shape, kp.shape, q.dtype)
+    ref = np.asarray(paged_attention_k(q, kp, vp, tables, pos))
+    out = np.asarray(pa.paged_decode_attention(q, kp, vp, tables, pos + 1,
+                                               interpret=True))
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-6)
+
+
+def test_paged_attention_supports_gate():
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import paged_attention as pa
+    ok = ((3, 1, 4, 128), (12, 8, 2, 128))
+    assert pa.supports(*ok, jnp.float32)
+    assert not pa.supports((3, 2, 4, 128), ok[1], jnp.float32)  # prefill
+    assert not pa.supports(ok[0], (12, 6, 2, 128), jnp.float32)  # bs % 8
+    assert not pa.supports(ok[0], (12, 8, 3, 128), jnp.float32)  # H % Hkv
+    assert not pa.supports((3, 1, 4, 64), (12, 8, 2, 64),
+                           jnp.float32)                # unaligned head_dim
+    assert not pa.supports(ok[0], ok[1], jnp.int32)
+
+
+def test_paged_prefill_matches_dense_forward(gpt):
+    """One whole-prompt paged forward == the plain dense forward (the
+    foundation of the engine's token parity)."""
+    import jax.numpy as jnp
+    from paddle_tpu.tensor import Tensor
+    m = gpt
+    m.eval()
+    ids = pt.randint(0, 64, [1, 6])
+    with pt.no_grad():
+        full = m(ids).numpy()
+        pool = BlockPool.for_model(m, num_blocks=8, block_size=4)
+        table = np.zeros((1, 2), np.int32)
+        table[0] = [3, 5]
+        caches = [{"k": Tensor._from_array(pool.k[i]),
+                   "v": Tensor._from_array(pool.v[i]),
+                   "table": Tensor._from_array(jnp.asarray(table)),
+                   "pos": Tensor._from_array(jnp.zeros(1, jnp.int32)),
+                   "limit": Tensor._from_array(
+                       jnp.full((1,), 6, jnp.int32))}
+                  for i in range(pool.num_layers)]
+        paged = m(ids, caches=caches).numpy()
+    np.testing.assert_allclose(paged, full, rtol=2e-4, atol=2e-5)
+
+
+# ===================================================================
+# generate(): per-sequence EOS stop in a batch (serving-reuse fix)
+# ===================================================================
+def test_generate_batch_eos_per_sequence():
+    m = _tiny_gpt()
+    a = [1, 2, 3, 4, 5]
+    b = [9, 8, 7, 6, 5]
+    # pick an eos the FIRST row emits early but the second does not
+    eos = _seq_ref(m, a, 1)[0]
+    solo_b = _seq_ref(m, b, 6, eos=eos)
+    batch = generate(m, pt.to_tensor(np.asarray([a, b], "int64")),
+                     max_new_tokens=6, eos_token_id=eos).numpy()
+    gen_a, gen_b = batch[0, 5:].tolist(), batch[1, 5:].tolist()
+    # the finished row is eos-padded right of its stop, not garbage...
+    assert all(t == eos for t in gen_a[gen_a.index(eos):])
+    # ...and the unfinished row decodes exactly its solo trajectory
+    assert gen_b[:len(solo_b)] == solo_b
+
+
+# ===================================================================
+# AOT artifacts: zero-compile warm replica start
+# ===================================================================
+def test_serving_aot_roundtrip_zero_compile(gpt, tmp_path):
+    import json
+    prompts = [[1, 2, 3, 4, 5], [7] * 11]
+    kw = dict(num_blocks=16, block_size=8, max_running=4,
+              prefill_chunk=16)
+    eng = LLMEngine(gpt, **kw)
+    refs = eng.generate_batch(prompts, max_new_tokens=5)
+    export_serving_artifacts(eng, str(tmp_path),
+                             prompt_lens=[len(p) for p in prompts])
+
+    warm = LLMEngine(gpt, **kw)
+    keys = load_serving_artifacts(warm, str(tmp_path))
+    assert ("decode",) in keys
+    assert warm.generate_batch(prompts, max_new_tokens=5) == refs
+    # the warm replica never traced/compiled a live program
+    assert warm._programs == {}
+
+    # a stamp mismatch must refuse WITH the reason (strict=True raises)
+    man = os.path.join(str(tmp_path), "serving_manifest.json")
+    with open(man) as f:
+        data = json.load(f)
+    data["stamp"]["jax"] = "0.0.0-somewhere-else"
+    with open(man, "w") as f:
+        json.dump(data, f)
+    cold = LLMEngine(gpt, **kw)
+    with pytest.warns(UserWarning, match="jax version"):
+        assert load_serving_artifacts(cold, str(tmp_path)) == []
+    from paddle_tpu.jit.save_load import AOTIncompatible
+    with pytest.raises(AOTIncompatible):
+        load_serving_artifacts(cold, str(tmp_path), strict=True)
+
+
+# ===================================================================
+# chaos sites + the overload drill (tier-1 wiring of --serving)
+# ===================================================================
+def test_pool_exhausted_chaos_site():
+    from paddle_tpu.resilience import chaos
+    pool = BlockPool(1, 8, 4, 2, 8)
+    with chaos.scoped("serving.pool_exhausted@1"):
+        assert pool.allocate(1) is None     # injected refusal
+        a = pool.allocate(1)                # next hit is clean
+        assert len(a) == 1
+    pool.free(a)
+
+
+def test_chaos_check_serving_inprocess():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "chaos_check", os.path.join(REPO, "tools", "chaos_check.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    buf = io.StringIO()
+    assert mod.run_serving(out=buf) == 0, buf.getvalue()
+    assert "zero block leaks" in buf.getvalue()
+
+
+# ===================================================================
+# request validation
+# ===================================================================
+def test_add_request_validation(gpt):
+    eng = LLMEngine(gpt, num_blocks=4, block_size=4)   # 16 token pool
+    with pytest.raises(ValueError):
+        eng.add_request([], max_new_tokens=4)
+    with pytest.raises(ValueError):
+        eng.add_request([1] * 60, max_new_tokens=10)  # > max_model_len
+    with pytest.raises(PoolExhausted):
+        eng.add_request([1] * 20, max_new_tokens=10)  # > whole pool

@@ -39,7 +39,16 @@ jit entry loads its serialized executable) with bit-exact loss
 continuity vs an uninterrupted reference; then a deterministically
 corrupted cache entry must be quarantined and silently recompiled.
 
+``--serving`` runs the **serving overload drill** instead: 8 requests
+against a block pool too small to hold them, with injected pool
+exhaustion (``serving.pool_exhausted``) and one poisoned request
+(``serving.request_poison``).  The continuous-batching engine must
+preempt/resume under pressure with every surviving request's output
+token-identical to a sequential ``generate()`` reference, fail only the
+poisoned request, and return every block (zero leaks, whole free list).
+
 Usage:  python tools/chaos_check.py [-v] [--mesh-change] [--cold-start]
+        [--serving]
 Exit 0 = all recovery paths green.
 """
 import argparse
@@ -693,6 +702,111 @@ def run_mesh_change(out=None, verbose=False):
     return 0
 
 
+def run_serving(out=None, verbose=False):
+    """The serving overload drill: a pool deliberately too small for the
+    offered load, plus injected exhaustion (`serving.pool_exhausted`) and
+    one poisoned request (`serving.request_poison`).  Green means the
+    continuous-batching engine preempted and resumed under pressure with
+    every surviving request's tokens IDENTICAL to a sequential
+    `generate()` reference, the poisoned request failed alone, and the
+    pool came back whole — zero leaked blocks, zero bad refcounts."""
+    out = out if out is not None else sys.stdout
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.serving import LLMEngine
+    from paddle_tpu.text import GPTConfig, GPTForCausalLM
+    from paddle_tpu.text.generation import generate
+
+    def log(msg):
+        if verbose:
+            print(msg, file=out)
+
+    failures = []
+    reg = metrics.registry()
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, max_position_embeddings=64,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    tensor_parallel=False)
+    model = GPTForCausalLM(cfg)
+    rs = np.random.RandomState(7)
+    prompts = [rs.randint(0, 64, size=n).tolist()
+               for n in (9, 5, 12, 7, 4, 10, 6, 8)]
+    new_tokens = 8
+    refs = [generate(model, paddle.to_tensor(np.asarray([p], "int64")),
+                     max_new_tokens=new_tokens).numpy()[0, len(p):].tolist()
+            for p in prompts]
+
+    base_pre = reg.counter("serving_requests_preempted_total").value
+    base_exh = reg.counter("serving_pool_exhausted_total").value
+    base_fail = reg.counter("serving_requests_failed_total").value
+
+    # pool of 7 x 4-token blocks serves 8 requests needing ~2-5 blocks
+    # each -> genuine overload; the chaos spec injects 3 EXTRA refusals
+    # mid-run and poisons the 3rd submitted request
+    with chaos.scoped("serving.pool_exhausted@6*3;"
+                      "serving.request_poison@3"):
+        eng = LLMEngine(model, num_blocks=7, block_size=4, max_running=8,
+                        prefill_chunk=16)
+        reqs = [eng.add_request(p, max_new_tokens=new_tokens)
+                for p in prompts]
+        eng.run(max_steps=10_000)
+
+    poisoned = [r for r in reqs if r.poisoned]
+    if len(poisoned) != 1 or poisoned[0] is not reqs[2]:
+        failures.append(f"expected exactly request #2 poisoned, got "
+                        f"{[r.id for r in poisoned]}")
+    for i, (req, ref) in enumerate(zip(reqs, refs)):
+        if req.poisoned:
+            if req.finish_reason != "error":
+                failures.append(
+                    f"poisoned request {i} finished {req.finish_reason!r},"
+                    f" expected 'error'")
+            continue
+        if req.finish_reason not in ("eos", "length"):
+            failures.append(f"request {i} ended {req.finish_reason!r}")
+        if list(req.generated) != ref:
+            failures.append(
+                f"request {i} tokens diverged after "
+                f"{req.preemptions} preemption(s): {req.generated} "
+                f"vs sequential {ref}")
+    n_pre = reg.counter("serving_requests_preempted_total").value - base_pre
+    n_exh = reg.counter("serving_pool_exhausted_total").value - base_exh
+    n_fail = reg.counter("serving_requests_failed_total").value - base_fail
+    log(f"preemptions={n_pre} exhaustions={n_exh} failed={n_fail}")
+    if n_pre < 1:
+        failures.append("overload never triggered a preemption — the "
+                        "drill pool is not actually under pressure")
+    if n_exh < 3:
+        failures.append(f"injected pool exhaustion did not fire 3 times "
+                        f"(saw {n_exh})")
+    if n_fail != 1:
+        failures.append(f"expected exactly 1 failed (poisoned) request, "
+                        f"counters saw {n_fail}")
+    leaked, bad = eng.pool.check_leaks()
+    if leaked or bad:
+        failures.append(f"block pool leaked: refcount>0 {leaked}, "
+                        f"refcount<0 {bad}")
+    if eng.pool.free_blocks != eng.pool.num_blocks:
+        failures.append(f"free list short after drain: "
+                        f"{eng.pool.free_blocks}/{eng.pool.num_blocks}")
+
+    if failures:
+        print("chaos_check --serving FAILED:", file=out)
+        for f in failures:
+            print(f"  - {f}", file=out)
+        return 1
+    print(f"chaos_check --serving OK: 8 requests over a 7-block pool, "
+          f"{n_pre} preemption(s) + 3 injected exhaustions + 1 poisoned "
+          f"request; every survivor token-identical to sequential "
+          f"generate(), poisoned request failed alone, zero block leaks",
+          file=out)
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("-v", "--verbose", action="store_true")
@@ -705,6 +819,12 @@ def main(argv=None):
                          "-> kill -> warm-cache restart with zero "
                          "recompiles; corrupt entry -> quarantine) "
                          "instead of the 4-family plan")
+    ap.add_argument("--serving", action="store_true",
+                    help="run the serving overload drill (pool too small "
+                         "+ injected exhaustion + poisoned request; "
+                         "preempted requests must finish token-identical "
+                         "to sequential generate() with zero block "
+                         "leaks) instead of the 4-family plan")
     ap.add_argument("--cold-start-worker", action="store_true",
                     help=argparse.SUPPRESS)   # the drill's restarted proc
     ap.add_argument("--cache-dir", help=argparse.SUPPRESS)
@@ -712,6 +832,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.cold_start_worker:
         return run_cold_worker(args.cache_dir, args.ckpt_root)
+    if args.serving:
+        return run_serving(verbose=args.verbose)
     if args.cold_start:
         return run_cold_start(verbose=args.verbose)
     if args.mesh_change:

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""serve — a thin driver over paddle_tpu.serving.LLMEngine.
+
+Builds a model, feeds it requests, streams tokens as they decode, and
+prints the serving metrics snapshot when the queue drains.  Requests
+are lines of space-separated token ids on stdin (one request per line),
+or ``--random N`` synthetic prompts.
+
+    # 6 random prompts through a tiny GPT, streaming
+    python tools/serve.py --random 6
+
+    # a real preset, AOT warm start from a prior --export-aot run
+    python tools/serve.py --preset gpt3-125M --load-aot /tmp/aot < ids.txt
+
+``--export-aot DIR`` writes the replica's per-bucket AOT artifacts
+(serving.aot) after the run, so the next replica starts zero-compile.
+See docs/serving.md.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--preset", default=None,
+                    help="GPTConfig preset (default: a tiny demo config)")
+    ap.add_argument("--random", type=int, default=0, metavar="N",
+                    help="serve N random prompts instead of stdin")
+    ap.add_argument("--max-new-tokens", type=int, default=24)
+    ap.add_argument("--eos", type=int, default=None)
+    ap.add_argument("--do-sample", action="store_true")
+    ap.add_argument("--temperature", type=float, default=1.0)
+    ap.add_argument("--top-k", type=int, default=None)
+    ap.add_argument("--top-p", type=float, default=None)
+    ap.add_argument("--num-blocks", type=int, default=64)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--max-running", type=int, default=8)
+    ap.add_argument("--prefill-chunk", type=int, default=64)
+    ap.add_argument("--export-aot", metavar="DIR", default=None,
+                    help="write per-bucket AOT artifacts after the run")
+    ap.add_argument("--load-aot", metavar="DIR", default=None,
+                    help="warm-start from exported AOT artifacts")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="no per-token streaming output")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import serving
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.text import GPTConfig, GPTForCausalLM
+
+    pt.seed(0)
+    if args.preset:
+        cfg = GPTConfig.from_preset(args.preset, hidden_dropout=0.0,
+                                    attention_dropout=0.0,
+                                    tensor_parallel=False)
+    else:
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                        num_heads=4, max_position_embeddings=256,
+                        hidden_dropout=0.0, attention_dropout=0.0,
+                        tensor_parallel=False)
+    with pt.LazyGuard():
+        model = GPTForCausalLM(cfg)
+
+    eng = serving.LLMEngine(model, num_blocks=args.num_blocks,
+                            block_size=args.block_size,
+                            max_running=args.max_running,
+                            prefill_chunk=args.prefill_chunk)
+    if args.load_aot:
+        keys = serving.load_serving_artifacts(eng, args.load_aot)
+        print(f"# AOT warm start: loaded {len(keys)} program(s)",
+              file=sys.stderr)
+
+    if args.random:
+        rs = np.random.RandomState(0)
+        prompts = [rs.randint(0, cfg.vocab_size,
+                              size=rs.randint(4, 32)).tolist()
+                   for _ in range(args.random)]
+    else:
+        prompts = [[int(t) for t in line.split()]
+                   for line in sys.stdin if line.strip()]
+    if not prompts:
+        print("no prompts (stdin empty and --random not given)",
+              file=sys.stderr)
+        return 2
+
+    def on_token(req, tok):
+        if not args.quiet:
+            print(f"req{req.id} +{tok}", flush=True)
+
+    def on_finish(req):
+        print(f"req{req.id} DONE ({req.finish_reason}): "
+              f"{' '.join(map(str, req.generated))}", flush=True)
+
+    for p in prompts:
+        eng.add_request(p, max_new_tokens=args.max_new_tokens,
+                        eos_token_id=args.eos, do_sample=args.do_sample,
+                        temperature=args.temperature, top_k=args.top_k,
+                        top_p=args.top_p, on_token=on_token,
+                        on_finish=on_finish)
+    steps = eng.run()
+
+    if args.export_aot:
+        serving.export_serving_artifacts(
+            eng, args.export_aot, prompt_lens=[len(p) for p in prompts])
+        print(f"# AOT artifacts exported to {args.export_aot}",
+              file=sys.stderr)
+
+    reg = metrics.registry()
+    snap = {m["name"]: m.get("value", m.get("count"))
+            for m in reg.snapshot()
+            if m["name"].startswith("serving_")}
+    print(json.dumps({"steps": steps, "requests": len(prompts),
+                      "metrics": snap}, indent=1), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
