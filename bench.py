@@ -374,21 +374,17 @@ def run_gpt_spec_decode(preset="gpt3-350M", draft_layers=2, batch=4,
             "devices": _dev_str()}
 
 
-def run_serving(preset="gpt3-125M", n_requests=24, arrival_rate=8.0,
-                prompt_lo=16, prompt_hi=96, new_tokens=32,
-                num_blocks=None, block_size=16, max_running=8,
-                seed=0, **cfg_kw):
-    """Serving throughput leg: the continuous-batching engine
-    (paddle_tpu/serving) against a seeded Poisson arrival trace, vs
-    SEQUENTIAL serving of the same trace (one `jit_generate` per request,
-    FCFS).  Reports aggregate tokens/s, requests/s and TTFT/TPOT
-    p50/p99 — the serving-relevant percentiles, measured per request
-    from its (virtual) arrival time."""
+def _serving_workload(preset, n_requests, arrival_rate, prompt_lo,
+                      prompt_hi, new_tokens, num_blocks, block_size,
+                      max_running, seed, **cfg_kw):
+    """Shared workload builder for the serving legs: model, seeded
+    prompts and Poisson arrivals, pool sizing.  Built exactly ONCE here
+    so the single-engine and router legs always benchmark the identical
+    trace (a drift between the two would silently invalidate the
+    comparison)."""
     import numpy as np
     import paddle_tpu as pt
-    from paddle_tpu.serving import LLMEngine
     from paddle_tpu.text import GPTConfig, GPTForCausalLM
-    from paddle_tpu.text.decode import jit_generate
 
     pt.seed(0)
     max_len = prompt_hi + new_tokens
@@ -404,17 +400,18 @@ def run_serving(preset="gpt3-125M", n_requests=24, arrival_rate=8.0,
                .tolist() for _ in range(n_requests)]
     # seeded Poisson arrivals: exponential inter-arrival gaps
     arrivals = np.cumsum(rs.exponential(1.0 / arrival_rate, n_requests))
-
     if num_blocks is None:
         # pool sized for ~max_running concurrent max-length requests
         num_blocks = max_running * (-(-max_len // block_size)) + 4
-    eng = LLMEngine(model, num_blocks=num_blocks, block_size=block_size,
-                    max_running=max_running, prefill_chunk=64)
-    # warm every program shape out of band (compiles don't belong in a
-    # throughput/latency measurement; AOT artifacts kill them in prod):
-    # one request per prefill bucket in the engine's inventory (a
-    # prompt of bucket+1 tokens prefills exactly one bucket-sized
-    # chunk), which also compiles the decode program
+    return cfg, model, rs, prompts, arrivals, max_len, num_blocks
+
+
+def _warm_serving_buckets(eng, rs, cfg, prompts, max_len):
+    """Warm every program shape out of band (compiles don't belong in a
+    throughput/latency measurement; AOT artifacts kill them in prod):
+    one request per prefill bucket in the engine's inventory (a prompt
+    of bucket+1 tokens prefills exactly one bucket-sized chunk), which
+    also compiles the decode program."""
     for key in eng.program_keys(prompt_lens=[len(p) for p in prompts]):
         if key[0] != "prefill":
             continue
@@ -422,6 +419,36 @@ def run_serving(preset="gpt3-125M", n_requests=24, arrival_rate=8.0,
         eng.generate_batch([rs.randint(0, cfg.vocab_size,
                                        size=n).tolist()],
                            max_new_tokens=2)
+
+
+def _pct(xs, p):
+    xs = sorted(xs)
+    return xs[min(int(p / 100.0 * len(xs)), len(xs) - 1)] if xs else 0
+
+
+def run_serving(preset="gpt3-125M", n_requests=24, arrival_rate=8.0,
+                prompt_lo=16, prompt_hi=96, new_tokens=32,
+                num_blocks=None, block_size=16, max_running=8,
+                seed=0, **cfg_kw):
+    """Serving throughput leg: the continuous-batching engine
+    (paddle_tpu/serving) against a seeded Poisson arrival trace, vs
+    SEQUENTIAL serving of the same trace (one `jit_generate` per request,
+    FCFS).  Reports aggregate tokens/s, requests/s and TTFT/TPOT
+    p50/p99 — the serving-relevant percentiles, measured per request
+    from its (virtual) arrival time."""
+    import paddle_tpu as pt
+    from paddle_tpu.serving import LLMEngine
+    from paddle_tpu.text.decode import jit_generate
+
+    import numpy as np
+
+    cfg, model, rs, prompts, arrivals, max_len, num_blocks = \
+        _serving_workload(preset, n_requests, arrival_rate, prompt_lo,
+                          prompt_hi, new_tokens, num_blocks, block_size,
+                          max_running, seed, **cfg_kw)
+    eng = LLMEngine(model, num_blocks=num_blocks, block_size=block_size,
+                    max_running=max_running, prefill_chunk=64)
+    _warm_serving_buckets(eng, rs, cfg, prompts, max_len)
 
     # engine latency fields (arrival_t/first_token_t) use time.monotonic,
     # so the trace clock must too; TTFT is measured against the VIRTUAL
@@ -451,10 +478,7 @@ def run_serving(preset="gpt3-125M", n_requests=24, arrival_rate=8.0,
         if len(r.generated) > 1:
             tpot.append((r.last_token_t - r.first_token_t)
                         / (len(r.generated) - 1))
-    tpot.sort()
-
-    def pct(xs, p):
-        return xs[min(int(p / 100.0 * len(xs)), len(xs) - 1)] if xs else 0
+    pct = _pct
 
     # --- sequential reference: same trace, one request at a time (jitted
     # decode; its per-shape programs also warm out of band — one compile
@@ -486,6 +510,149 @@ def run_serving(preset="gpt3-125M", n_requests=24, arrival_rate=8.0,
             "n_requests": n_requests, "new_tokens": new_tokens,
             "preemptions": sum(r.preemptions for r in reqs),
             "devices": _dev_str()}
+
+
+def run_serving_router(preset="gpt3-125M", replicas=2, n_requests=24,
+                       arrival_rate=8.0, prompt_lo=16, prompt_hi=96,
+                       new_tokens=32, num_blocks=None, block_size=16,
+                       max_running=8, seed=0, burst_factor=6.0,
+                       burst_requests=64, shed_queue_depth=None,
+                       **cfg_kw):
+    """Router leg: the SAME seeded Poisson trace through the
+    multi-replica Router (replicas warm-started from per-bucket AOT
+    artifacts, so scale-out adds zero compiles) vs one engine, then an
+    overload burst (arrival rate x `burst_factor`) with watermark
+    shedding armed — routed TTFT/TPOT p50/p99 and the shed rate are the
+    serving-tier acceptance numbers (fast refusals, bounded p99,
+    instead of unbounded queue growth)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from paddle_tpu.serving import (LLMEngine, Router, ShedRequest,
+                                    export_serving_artifacts,
+                                    load_serving_artifacts)
+
+    cfg, model, rs, prompts, arrivals, max_len, num_blocks = \
+        _serving_workload(preset, n_requests, arrival_rate, prompt_lo,
+                          prompt_hi, new_tokens, num_blocks, block_size,
+                          max_running, seed, **cfg_kw)
+    if shed_queue_depth is None:
+        # per-replica backlog cap: one full decode batch of queued work
+        # behind the running batch — past that, waiting costs more than
+        # a fast refusal
+        shed_queue_depth = max_running
+
+    def factory(**overrides):
+        kw = dict(num_blocks=num_blocks, block_size=block_size,
+                  max_running=max_running, prefill_chunk=64)
+        kw.update(overrides)
+        return LLMEngine(model, **kw)
+
+    pct = _pct
+
+    def drive(submit, backend, trace_arrivals, trace_prompts):
+        """Feed the virtual-arrival trace; TTFT/TPOT measured per
+        request against its VIRTUAL arrival on one monotonic clock
+        (submit lag inside a step is part of the latency)."""
+        t0 = time.monotonic()
+        submitted, reqs, shed = 0, [], 0
+        while submitted < len(trace_prompts) or backend.has_work:
+            now = time.monotonic() - t0
+            while submitted < len(trace_prompts) and \
+                    trace_arrivals[submitted] <= now:
+                try:
+                    reqs.append(submit(trace_prompts[submitted]))
+                except ShedRequest:
+                    shed += 1
+                    reqs.append(None)
+                submitted += 1
+            if backend.has_work:
+                backend.step()
+            elif submitted < len(trace_prompts):
+                time.sleep(min(0.001,
+                               trace_arrivals[submitted] - now))
+        dt = time.monotonic() - t0
+        ttft = [r.first_token_t - (t0 + trace_arrivals[i])
+                for i, r in enumerate(reqs)
+                if r is not None and r.first_token_t is not None]
+        tpot = []
+        for r in reqs:
+            if r is None:
+                continue
+            n = len(r.emitted if hasattr(r, "emitted") else r.generated)
+            if n > 1 and r.last_token_t is not None:
+                tpot.append((r.last_token_t - r.first_token_t) / (n - 1))
+        toks = sum(len(r.emitted if hasattr(r, "emitted")
+                       else r.generated)
+                   for r in reqs if r is not None)
+        return {"reqs": reqs, "dt": dt, "shed": shed, "tokens": toks,
+                "ttft": ttft, "tpot": tpot}
+
+    # ---- warm one engine, export AOT so every replica starts warm ----
+    aot_dir = tempfile.mkdtemp(prefix="bench_router_aot_")
+    try:
+        one = factory()
+        _warm_serving_buckets(one, rs, cfg, prompts, max_len)
+        export_serving_artifacts(one, aot_dir,
+                                 prompt_lens=[len(p) for p in prompts])
+
+        def warm(eng):
+            load_serving_artifacts(eng, aot_dir)
+
+        # ---- leg A: one engine, the trace -----------------------------
+        eng_run = drive(
+            lambda p: one.add_request(p, max_new_tokens=new_tokens),
+            one, arrivals, prompts)
+
+        # ---- leg B: the router over N warm replicas, same trace -------
+        router = Router(factory, replicas=replicas,
+                        heartbeat_timeout=30.0, warm_start=warm)
+        rt_run = drive(
+            lambda p: router.submit(p, max_new_tokens=new_tokens),
+            router, arrivals, prompts)
+        router.close()
+
+        # ---- leg C: overload burst, watermark shedding armed ----------
+        burst_rate = arrival_rate * burst_factor
+        burst_prompts = [rs.randint(0, cfg.vocab_size,
+                                    size=rs.randint(prompt_lo,
+                                                    prompt_hi + 1))
+                         .tolist() for _ in range(burst_requests)]
+        burst_arrivals = np.cumsum(
+            rs.exponential(1.0 / burst_rate, burst_requests))
+        shed_router = Router(
+            lambda: factory(shed_queue_depth=shed_queue_depth),
+            replicas=replicas, heartbeat_timeout=30.0, warm_start=warm)
+        burst = drive(
+            lambda p: shed_router.submit(p, max_new_tokens=new_tokens),
+            shed_router, burst_arrivals, burst_prompts)
+        leaks = shed_router.close()
+    finally:
+        shutil.rmtree(aot_dir, ignore_errors=True)
+
+    return {
+        "replicas": replicas,
+        "tps_one": eng_run["tokens"] / eng_run["dt"],
+        "tps_router": rt_run["tokens"] / rt_run["dt"],
+        "speedup": (rt_run["tokens"] / rt_run["dt"])
+        / (eng_run["tokens"] / eng_run["dt"]),
+        "ttft_p50_s": round(pct(rt_run["ttft"], 50), 4),
+        "ttft_p99_s": round(pct(rt_run["ttft"], 99), 4),
+        "tpot_p50_s": round(pct(rt_run["tpot"], 50), 4),
+        "tpot_p99_s": round(pct(rt_run["tpot"], 99), 4),
+        "one_ttft_p99_s": round(pct(eng_run["ttft"], 99), 4),
+        "burst": {
+            "arrival_rate": burst_rate,
+            "requests": burst_requests,
+            "shed": burst["shed"],
+            "shed_rate": burst["shed"] / burst_requests,
+            "admitted_ttft_p99_s": round(pct(burst["ttft"], 99), 4),
+            "leak_free": all(not l and not b
+                             for l, b in leaks.values()),
+        },
+        "n_requests": n_requests, "new_tokens": new_tokens,
+        "devices": _dev_str()}
 
 
 def _dev_str():
@@ -692,7 +859,8 @@ CHILD_FNS = {"gpt": run_gpt, "resnet": run_resnet, "llama": run_llama,
              "gpt_decode": run_gpt_decode,
              "gpt_spec_decode": run_gpt_spec_decode,
              "cold_start": run_cold_start,
-             "serving": run_serving}
+             "serving": run_serving,
+             "serving_router": run_serving_router}
 
 
 def _child_main(spec):
@@ -835,7 +1003,14 @@ def main():
         # standalone serving leg (ISSUE 10 acceptance check): runs
         # in-process on whatever backend jax picked (CPU tier-1 uses a
         # tiny config so the comparison finishes in seconds) and prints
-        # ONE json line on stdout
+        # ONE json line on stdout.  `--replicas N` (N>1) runs the
+        # ROUTER leg instead: same trace through the serving tier vs
+        # one engine + an overload burst with watermark shedding
+        # (ISSUE 11 acceptance numbers: routed TTFT/TPOT p50/p99 and
+        # the shed rate).
+        replicas = 1
+        if "--replicas" in sys.argv:
+            replicas = int(sys.argv[sys.argv.index("--replicas") + 1])
         tiny = os.environ.get("JAX_PLATFORMS", "") == "cpu" or \
             os.environ.get("BENCH_FORCE_CPU") == "1"
         kw = dict(preset="gpt3-125M")
@@ -843,6 +1018,17 @@ def main():
             kw = dict(preset="gpt3-125M", hidden_size=64, num_layers=2,
                       num_heads=4, n_requests=12, arrival_rate=20.0,
                       prompt_lo=8, prompt_hi=48, new_tokens=16)
+        if replicas > 1:
+            res = run_serving_router(replicas=replicas, **kw)
+            print(json.dumps({
+                "metric": "multi-replica router serving tokens/sec",
+                "value": round(res["tps_router"], 1),
+                "vs_baseline": round(res["speedup"], 3), **{
+                    k: res[k] for k in (
+                        "replicas", "tps_one", "ttft_p50_s",
+                        "ttft_p99_s", "tpot_p50_s", "tpot_p99_s",
+                        "one_ttft_p99_s", "burst")}}))
+            return
         res = run_serving(**kw)
         print(json.dumps({
             "metric": "continuous-batching serving tokens/sec",

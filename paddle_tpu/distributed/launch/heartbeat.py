@@ -24,32 +24,83 @@ import time
 _ACTIVE = None  # singleton: one beating thread per process
 
 
-class _Heartbeat:
-    def __init__(self, path, interval):
+class Heartbeat:
+    """The beat writer.  Two modes:
+
+    * ``start()`` arms the daemon thread — *process* liveness, the
+      launch-supervisor contract above (beats while the main thread is
+      stuck inside XLA).
+    * manual ``beat()`` with no thread — *loop* liveness: the serving
+      router's replicas beat from their scheduler loop, because for a
+      serving replica "alive" means *making scheduling progress*; a
+      daemon thread would keep a wedged engine looking healthy, which
+      is exactly the hang the beat exists to expose.
+    """
+
+    def __init__(self, path, interval=1.0):
         self.path = path
         self.interval = float(interval)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="pt-heartbeat")
 
-    def _beat(self):
+    def beat(self):
         with open(self.path, "a"):
             os.utime(self.path, None)
+
+    _beat = beat   # internal alias, kept for callers of the old name
 
     def _run(self):
         while not self._stop.wait(self.interval):
             try:
-                self._beat()
+                self.beat()
             except OSError:
                 pass    # a vanished log dir must not kill the worker
 
     def start(self):
-        self._beat()   # first beat synchronously: the supervisor sees a
+        self.beat()    # first beat synchronously: the supervisor sees a
         self._thread.start()   # live file before any interval elapses
         return self
 
     def stop(self):
         self._stop.set()
+
+
+_Heartbeat = Heartbeat   # pre-router name, kept importable
+
+
+class BeatWatch:
+    """Supervisor-side staleness detector for one beat file.  The mtime
+    is only a *change* detector; silence is measured on the WATCHER's
+    monotonic clock (the launch-supervisor rule: a wall-clock step /
+    NTP jump must never declare a whole fleet hung at once).  A fresh
+    watch starts its clock at construction, so a just-(re)spawned
+    worker gets a full timeout of grace before it must beat."""
+
+    def __init__(self, path, timeout, clock=time.monotonic):
+        self.path = path
+        self.timeout = float(timeout)
+        self._clock = clock
+        self._last_mtime = None
+        self._last_change = clock()
+
+    @property
+    def silent_for(self):
+        return self._clock() - self._last_change
+
+    def stale(self):
+        """True when the file hasn't changed for longer than `timeout`
+        on this watcher's clock."""
+        now = self._clock()
+        try:
+            mtime = os.stat(self.path).st_mtime
+        except OSError:
+            mtime = None          # never beat yet: grace period applies
+        if mtime is not None and mtime != self._last_mtime:
+            self._last_mtime = mtime
+            self._last_change = now
+            return False
+        return now - self._last_change > self.timeout
 
 
 def start_heartbeat(path=None, interval=None):

@@ -57,6 +57,15 @@ Fault sites (see docs/resilience.md for the full table):
                                 (NaN) — the engine must fail THAT
                                 request and free its blocks without
                                 touching the rest of the batch
+    serving.replica_kill        a router replica's step raises (the
+                                in-process stand-in for a dead serving
+                                process) — the router must evict it and
+                                fail its streams over to a survivor
+    serving.replica_hang        a router replica stops stepping AND
+                                beating its heartbeat — the router must
+                                detect the stale beat within the
+                                configured timeout and evict it as a
+                                hang (distinct from a crash)
 
 Zero-cost when disabled: every site guards on the module-level
 ``_PLAN is None`` check before doing any work.

@@ -13,6 +13,11 @@ KV cache (the ROADMAP "millions of users" serving layer).
                 percentiles into the PR-2 metrics registry
     aot         per-bucket AOT artifacts (export/load) for zero-compile
                 warm replica start — the PR 7 follow-up
+    router      Router: the survival tier over N replicas — least-loaded
+                admission + session affinity, heartbeat health (stale
+                beat = hang, raise = crash), failover re-prefill with
+                router-side dedup, backoff respawn with crash-loop
+                abort, two-level load shedding (ShedRequest)
 
 The decode hot path is the `paged_attention` op: a pallas TPU kernel
 (ops/pallas/paged_attention.py) streaming pool blocks through each
@@ -23,11 +28,13 @@ from __future__ import annotations
 
 from .block_pool import BlockPool, PoolExhausted  # noqa: F401
 from .scheduler import Request, Scheduler  # noqa: F401
-from .engine import LLMEngine  # noqa: F401
+from .engine import LLMEngine, ShedRequest  # noqa: F401
+from .router import EngineReplica, RoutedRequest, Router  # noqa: F401
 from .aot import (  # noqa: F401
     export_serving_artifacts, load_serving_artifacts,
 )
 
 __all__ = ["BlockPool", "PoolExhausted", "Request", "Scheduler",
-           "LLMEngine", "export_serving_artifacts",
+           "LLMEngine", "ShedRequest", "Router", "RoutedRequest",
+           "EngineReplica", "export_serving_artifacts",
            "load_serving_artifacts"]

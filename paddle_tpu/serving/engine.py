@@ -45,10 +45,26 @@ from .block_pool import BlockPool, PoolExhausted
 from .scheduler import RUNNING, Request, Scheduler
 
 
+class ShedRequest(RuntimeError):
+    """Admission-control refusal — the structured "fast no" overload
+    degrades to instead of unbounded queueing.  `reason` names the
+    watermark that tripped (``queue_depth`` / ``free_blocks`` /
+    ``draining`` / ``no_healthy_replica``); `detail` carries the gauge
+    values at refusal time so callers (and clients) can see why."""
+
+    def __init__(self, reason, **detail):
+        self.reason = reason
+        self.detail = detail
+        extras = ", ".join(f"{k}={v}" for k, v in detail.items())
+        super().__init__(f"request shed ({reason}"
+                         + (f": {extras}" if extras else "") + ")")
+
+
 class LLMEngine:
     def __init__(self, model, num_blocks=64, block_size=16, max_running=8,
                  prefill_chunk=64, buckets=None, max_model_len=None,
-                 dtype=None):
+                 dtype=None, shed_queue_depth=None, shed_free_blocks=None,
+                 promote_after=4):
         if getattr(getattr(model, "cfg", None), "sliding_window", None):
             raise NotImplementedError(
                 "sliding_window models cannot serve from the paged pool "
@@ -58,8 +74,17 @@ class LLMEngine:
         self.pool = BlockPool.for_model(model, num_blocks,
                                         block_size=block_size, dtype=dtype)
         self.pool.shard_()
-        self.scheduler = Scheduler(self.pool, max_running=max_running)
+        self.scheduler = Scheduler(self.pool, max_running=max_running,
+                                   promote_after=promote_after)
         self.max_running = int(max_running)
+        # admission-control watermarks (None = never shed): overload
+        # must degrade to fast structured refusals, not unbounded p99
+        self.shed_queue_depth = (None if shed_queue_depth is None
+                                 else int(shed_queue_depth))
+        self.shed_free_blocks = (None if shed_free_blocks is None
+                                 else int(shed_free_blocks))
+        self._draining = False
+        self._closed = False
         self.prefill_chunk = int(prefill_chunk)
         self.policy = buckets if isinstance(buckets, BucketPolicy) \
             else BucketPolicy(buckets=buckets)
@@ -80,9 +105,26 @@ class LLMEngine:
     # ------------------------------------------------------------- requests
     def add_request(self, prompt_ids, max_new_tokens=20, eos_token_id=None,
                     do_sample=False, temperature=1.0, top_k=None,
-                    top_p=None, seed=0, on_token=None, on_finish=None):
+                    top_p=None, seed=0, on_token=None, on_finish=None,
+                    resume_tokens=None, arrival_t=None,
+                    queue_deadline_s=None, ttl_s=None, shed_exempt=False):
         """Queue a request; returns the Request handle (its `generated`
-        list fills in as `step()` runs; `on_token(req, tok)` streams)."""
+        list fills in as `step()` runs; `on_token(req, tok)` streams).
+
+        `resume_tokens` seeds already-generated tokens (router failover:
+        the survivor re-prefills prompt+resume and continues decoding at
+        the next position — the preemption-resume path, so continuation
+        is token-identical).  `arrival_t` preserves the original arrival
+        across a failover so `ttl_s` keeps meaning total lifetime.
+        `shed_exempt` bypasses the admission watermarks: a failed-over
+        request already held capacity once — shedding it would tear a
+        live stream to save queue slots it is owed.
+
+        Raises :class:`ShedRequest` when an admission watermark trips
+        (a structured refusal — nothing was allocated), ValueError /
+        PoolExhausted on requests that could never be served."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
         prompt = np.asarray(prompt_ids).reshape(-1).astype(np.int64)
         if len(prompt) < 1:
             raise ValueError("empty prompt")
@@ -95,15 +137,49 @@ class LLMEngine:
             raise PoolExhausted(
                 f"request needs {self.pool.blocks_for(total)} blocks; "
                 f"pool has {self.pool.num_blocks} total")
+        if resume_tokens and len(resume_tokens) >= int(max_new_tokens):
+            raise ValueError(
+                f"resume_tokens already holds {len(resume_tokens)} of "
+                f"max_new_tokens={max_new_tokens} — nothing left to "
+                f"generate")
+        if not shed_exempt:
+            self._check_shed()
         req = Request(prompt, max_new_tokens=max_new_tokens,
                       eos_token_id=eos_token_id, do_sample=do_sample,
                       temperature=temperature, top_k=top_k, top_p=top_p,
-                      seed=seed, on_token=on_token, on_finish=on_finish)
+                      seed=seed, on_token=on_token, on_finish=on_finish,
+                      resume_tokens=resume_tokens, arrival_t=arrival_t,
+                      queue_deadline_s=queue_deadline_s, ttl_s=ttl_s)
         if chaos.fire("serving.request_poison", tag=req.id):
             req.poisoned = True
         self.scheduler.submit(req)
         self._reg.counter("serving_requests_submitted_total").inc()
         return req
+
+    def _check_shed(self):
+        """Admission control: refuse-with-reason BEFORE any allocation
+        when a watermark is crossed, so overload costs the client one
+        exception instead of an unbounded queue wait."""
+        sched = self.scheduler
+        if self._draining:
+            self._shed("draining", queue_depth=sched.queue_depth)
+        if (self.shed_queue_depth is not None
+                and sched.queue_depth >= self.shed_queue_depth):
+            self._shed("queue_depth", queue_depth=sched.queue_depth,
+                       watermark=self.shed_queue_depth)
+        # low free blocks only sheds when a backlog already exists —
+        # with an empty queue the request admits immediately and normal
+        # preemption handles transient pool pressure
+        if (self.shed_free_blocks is not None and sched.queue_depth > 0
+                and self.pool.free_blocks < self.shed_free_blocks):
+            self._shed("free_blocks", free_blocks=self.pool.free_blocks,
+                       watermark=self.shed_free_blocks,
+                       queue_depth=sched.queue_depth)
+
+    def _shed(self, reason, **detail):
+        self._reg.counter("serving_requests_shed_total",
+                          reason=reason).inc()
+        raise ShedRequest(reason, **detail)
 
     @property
     def has_work(self):
@@ -130,6 +206,7 @@ class LLMEngine:
         """One continuous-batching iteration.  Returns a summary dict."""
         sched = self.scheduler
         now = time.monotonic()
+        self._expire(now)
         admitted = sched.admit()
         for req in admitted:
             self._reg.counter("serving_requests_admitted_total").inc()
@@ -138,6 +215,7 @@ class LLMEngine:
 
         # ---- prefill lane: a bounded token budget per step
         budget = self.prefill_chunk
+        prefilled = 0
         for req in list(sched.running):
             if budget <= 0:
                 break
@@ -146,6 +224,7 @@ class LLMEngine:
             n = min(budget, req.feed_len - 1 - req.ctx)
             self._prefill(req, n)
             budget -= n
+            prefilled += n
 
         # ---- decode lane: every decode-ready request advances one token
         ready = []
@@ -165,8 +244,69 @@ class LLMEngine:
         self._reg.gauge("serving_running_requests").set(len(sched.running))
         self._reg.gauge("serving_free_blocks").set(self.pool.free_blocks)
         return {"admitted": len(admitted), "decoded": len(ready),
+                "prefilled": prefilled,
                 "running": len(sched.running),
                 "waiting": sched.queue_depth}
+
+    def _expire(self, now):
+        """Deadline sweep: queue-wait and TTL expiry are CLEAN finishes
+        — blocks freed, `on_finish` fired with a structured reason —
+        never a stuck slot."""
+        sched = self.scheduler
+        for req in list(sched.waiting) + list(sched.running):
+            why = req.expiry(now)
+            if why is not None:
+                self._finish(req, f"expired-{why}")
+
+    # ------------------------------------------------------ drain / close
+    def cancel(self, req, reason="cancelled"):
+        """Abort a queued or running request: frees its blocks, fires
+        `on_finish` with the given reason.  No-op once finished."""
+        if req.finish_reason is None:
+            self._finish(req, reason)
+
+    def drain(self, ttl_s=None, max_steps=None):
+        """Graceful shutdown, phase 1 (the CheckpointManager preemption-
+        flush pattern: the signal handler only records, the main loop
+        flushes): stop admitting (`add_request` sheds with reason
+        ``draining``), expire every queued request immediately, then
+        step until running work finishes — or, past ``ttl_s`` seconds,
+        expire what remains.  Returns a summary dict."""
+        self._draining = True
+        already = sum(1 for r in self._finished
+                      if r.finish_reason == "drained")
+        for req in list(self.scheduler.waiting):
+            self._finish(req, "drained")
+        deadline = None if ttl_s is None else time.monotonic() + ttl_s
+        n = 0
+        while self.scheduler.running and \
+                (max_steps is None or n < max_steps):
+            if deadline is not None and time.monotonic() > deadline:
+                for req in list(self.scheduler.running):
+                    self._finish(req, "drained")
+                break
+            self.step()
+            n += 1
+        return {"steps": n,
+                "drained": sum(1 for r in self._finished
+                               if r.finish_reason == "drained")
+                - already}
+
+    def close(self):
+        """Graceful shutdown, phase 2: expire any work still live, then
+        release the pool's device arrays and compiled programs.  Returns
+        `pool.check_leaks()` (must be clean — the drill asserts it)."""
+        for req in (list(self.scheduler.running)
+                    + list(self.scheduler.waiting)):
+            self._finish(req, "drained")
+        leaks = self.pool.check_leaks()
+        self.pool.k = []
+        self.pool.v = []
+        self._programs.clear()
+        self._aot_execs.clear()
+        self._closed = True
+        self._draining = True
+        return leaks
 
     # ------------------------------------------------------------- programs
     def retire_aot(self, key=None):
@@ -358,8 +498,12 @@ class LLMEngine:
         req.generated.append(tok)
         if req.first_token_t is None:
             req.first_token_t = now
-            self._reg.histogram("serving_ttft_seconds").observe(
-                now - req.arrival_t)
+            if not req.resumed:
+                # a failed-over request's replica-local TTFT is not an
+                # arrival→first-token latency; the router's routed
+                # histograms own the end-to-end number
+                self._reg.histogram("serving_ttft_seconds").observe(
+                    now - req.arrival_t)
         elif req.last_token_t is not None:
             self._reg.histogram("serving_tpot_seconds").observe(
                 now - req.last_token_t)
@@ -367,17 +511,30 @@ class LLMEngine:
         self._reg.counter("serving_tokens_generated_total").inc()
         if req.on_token is not None:
             req.on_token(req, tok)
+            if req.finish_reason is not None:
+                return    # the callback cancelled/finished the request
         if req.eos_token_id is not None and tok == req.eos_token_id:
             self._finish(req, "eos")
         elif len(req.generated) >= req.max_new_tokens:
             self._finish(req, "length")
 
     def _finish(self, req, reason):
+        if req.finish_reason is not None:
+            return        # already settled: finishing is idempotent
         self.scheduler.finish(req, reason)
         self._finished.append(req)
-        name = ("serving_requests_failed_total" if reason == "error"
-                else "serving_requests_finished_total")
-        self._reg.counter(name).inc()
+        if reason in ("eos", "length"):
+            self._reg.counter("serving_requests_finished_total").inc()
+        elif reason in ("error", "cancelled"):
+            self._reg.counter("serving_requests_failed_total").inc()
+        elif reason == "drained":
+            self._reg.counter("serving_requests_expired_total",
+                              where="drain").inc()
+        elif reason.startswith("expired-"):
+            self._reg.counter("serving_requests_expired_total",
+                              where=reason[len("expired-"):]).inc()
+        else:
+            self._reg.counter("serving_requests_failed_total").inc()
         if req.on_finish is not None:
             req.on_finish(req)
 
@@ -387,17 +544,18 @@ def _sample_row(req, logits_row):
     np.argmax — token-identical to the sequential generate() path;
     sampled mode filters through the ONE `generation.filter_logits`
     implementation (so temperature/top-k/top-p semantics can never
-    drift from generate()) but draws from a per-request seeded numpy
-    Generator — a deterministic stream per (prompt, seed), independent
-    of batch composition, unlike sharing one jax key across the whole
-    batch."""
+    drift from generate()) and draws from a numpy Generator seeded per
+    (request seed, POSITION) — deterministic regardless of batch
+    composition AND of where the request is served: a failover resume
+    re-derives exactly the stream a single replica would have drawn
+    (one shared stateful Generator could not survive a resume — its
+    cursor would restart)."""
     if not req.do_sample:
         return int(np.argmax(logits_row))
-    if req._rng is None:
-        req._rng = np.random.default_rng(req.seed)
     from ..text.generation import filter_logits
     filtered = filter_logits(jnp.asarray(logits_row)[None, :],
                              req.temperature, req.top_k, req.top_p)[0]
     p = np.asarray(jax.nn.softmax(filtered), dtype=np.float64)
     p = p / p.sum()      # exact renormalization for rng.choice
-    return int(req._rng.choice(len(p), p=p))
+    rng = np.random.default_rng([req.seed, len(req.generated)])
+    return int(rng.choice(len(p), p=p))

@@ -14,6 +14,20 @@ Policy (vLLM-style, adapted to the static-slot decode program):
   readmission).  Oldest-first eviction would starve the head of the
   line; evicting the youngest bounds any request's preemption count by
   the pool's churn, which is the fairness half of the admission story.
+* **Starvation guard (aging)**: under the router's sustained load, LIFO
+  eviction plus front-of-queue resume can ping-pong two block-hungry
+  requests forever.  A request that has been preempted or head-of-line
+  blocked ``promote_after`` times total is PROMOTED: it becomes immune
+  to preemption by non-promoted requests (promoted requesters may still
+  evict each other, so the pool can never deadlock), breaking the
+  livelock while keeping eviction cheap for the common case.  Each
+  promotion steps ``serving_starvation_promotions_total``.
+* **Deadlines**: a request may carry ``queue_deadline_s`` (max
+  continuous wait in the queue, re-armed on preemption requeue) and
+  ``ttl_s`` (max total lifetime from arrival — failover resubmission
+  preserves the original arrival).  The engine sweeps both at the top
+  of every step; expiry is a CLEAN finish: blocks freed, ``on_finish``
+  fired with ``finish_reason`` ``expired-queue`` / ``expired-ttl``.
 * **Prefill/decode split**: prefill happens in bounded chunks
   (`prefill_chunk` tokens per engine step), so a long prompt occupies
   the prefill lane for many steps while every decode-ready request
@@ -30,6 +44,7 @@ RUNNING = "running"
 PREEMPTED = "preempted"
 FINISHED = "finished"
 FAILED = "failed"
+EXPIRED = "expired"
 
 
 class Request:
@@ -39,7 +54,8 @@ class Request:
 
     def __init__(self, prompt_ids, max_new_tokens=20, eos_token_id=None,
                  do_sample=False, temperature=1.0, top_k=None, top_p=None,
-                 seed=0, on_token=None, on_finish=None):
+                 seed=0, on_token=None, on_finish=None, resume_tokens=None,
+                 arrival_t=None, queue_deadline_s=None, ttl_s=None):
         self.id = Request._next_id
         Request._next_id += 1
         self.prompt = [int(t) for t in prompt_ids]
@@ -54,15 +70,31 @@ class Request:
         self.on_finish = on_finish
 
         self.state = WAITING
-        self.generated = []         # emitted token ids
+        # `resume_tokens` seeds `generated` with tokens a PRIOR replica
+        # already produced (router failover): re-prefill streams
+        # prompt+generated and decode continues at the next position —
+        # the same path a preemption-resume takes, so the continuation
+        # is token-identical to never having moved.
+        self.generated = [int(t) for t in (resume_tokens or [])]
+        # resumed means "a prior replica served part of this stream" —
+        # true even when the resume list is EMPTY (a failover after one
+        # emitted token trims the whole overlap away), so the replica-
+        # local TTFT observation is still suppressed
+        self.resumed = resume_tokens is not None
         self.block_table = []       # pool block ids, position-ordered
         self.ctx = 0                # tokens whose K/V live in the pool
         self.finish_reason = None
         self.poisoned = False       # chaos serving.request_poison
         self.preemptions = 0
-        self._rng = None            # lazy np.random.Generator (sampling)
+        self.admit_skips = 0        # head-of-line blocked admit passes
+        self.promoted = False       # starvation guard: victim immunity
 
-        self.arrival_t = time.monotonic()
+        self.arrival_t = (time.monotonic() if arrival_t is None
+                          else float(arrival_t))
+        self.queued_t = time.monotonic()   # start of the CURRENT wait
+        self.queue_deadline_s = (None if queue_deadline_s is None
+                                 else float(queue_deadline_s))
+        self.ttl_s = None if ttl_s is None else float(ttl_s)
         self.first_token_t = None
         self.last_token_t = None
 
@@ -90,6 +122,20 @@ class Request:
     def feed_tokens(self):
         return self.prompt + self.generated
 
+    def expiry(self, now):
+        """``"ttl"`` / ``"queue"`` when a deadline has passed, else
+        None.  TTL counts from arrival (which failover preserves); the
+        queue-wait deadline counts the CURRENT continuous wait only, so
+        a preemption re-arms it rather than inheriting the whole
+        history TTL already covers."""
+        if self.ttl_s is not None and now - self.arrival_t > self.ttl_s:
+            return "ttl"
+        if (self.queue_deadline_s is not None
+                and self.state in (WAITING, PREEMPTED)
+                and now - self.queued_t > self.queue_deadline_s):
+            return "queue"
+        return None
+
     def __repr__(self):
         return (f"Request(id={self.id}, state={self.state}, "
                 f"prompt={len(self.prompt)}, gen={len(self.generated)}, "
@@ -99,9 +145,12 @@ class Request:
 class Scheduler:
     """Admission / eviction / preemption against the block pool."""
 
-    def __init__(self, pool, max_running=8):
+    def __init__(self, pool, max_running=8, promote_after=4):
         self.pool = pool
         self.max_running = int(max_running)
+        # skips (preemptions + head-blocked admit passes) before a
+        # request is promoted out of the victim pool; 0/None disables
+        self.promote_after = int(promote_after or 0)
         self.waiting = collections.deque()
         self.running = []           # admission-ordered (oldest first)
 
@@ -111,6 +160,7 @@ class Scheduler:
 
     def submit(self, req):
         req.state = WAITING
+        req.queued_t = time.monotonic()
         self.waiting.append(req)
 
     def admit(self):
@@ -124,7 +174,12 @@ class Scheduler:
             need = self.pool.blocks_for(req.feed_len + 1)
             blocks = self.pool.allocate(need)
             if blocks is None:
-                break               # head-of-line blocks: stay FCFS
+                # head-of-line blocks: stay FCFS, but count the skip —
+                # a head stuck behind LIFO-resumed work ages toward
+                # promotion just like a preemption victim
+                req.admit_skips += 1
+                self._maybe_promote(req)
+                break
             self.waiting.popleft()
             req.block_table = blocks
             req.ctx = 0
@@ -143,17 +198,35 @@ class Scheduler:
             if got is not None:
                 req.block_table.extend(got)
                 continue
-            victim = self._pick_victim(exclude=req)
+            victim = self._pick_victim(exclude=req,
+                                       allow_promoted=req.promoted)
             if victim is None:
                 return False
             self.preempt(victim)
         return True
 
-    def _pick_victim(self, exclude):
+    def _pick_victim(self, exclude, allow_promoted=False):
+        """Youngest running request that isn't `exclude` and isn't
+        promoted.  A PROMOTED requester may fall back to evicting a
+        promoted victim (youngest first) — promotion shields against
+        un-promoted churn, never deadlocks the pool."""
         for cand in reversed(self.running):      # youngest admission last
-            if cand is not exclude:
+            if cand is not exclude and not cand.promoted:
                 return cand
+        if allow_promoted:
+            for cand in reversed(self.running):
+                if cand is not exclude:
+                    return cand
         return None
+
+    def _maybe_promote(self, req):
+        if (self.promote_after and not req.promoted
+                and req.preemptions + req.admit_skips
+                >= self.promote_after):
+            req.promoted = True
+            from ..observability import metrics as _metrics
+            _metrics.registry().counter(
+                "serving_starvation_promotions_total").inc()
 
     def preempt(self, req):
         """Evict: free every block now, requeue at the FRONT; the prefix
@@ -166,6 +239,8 @@ class Scheduler:
         req.ctx = 0
         req.preemptions += 1
         req.state = PREEMPTED
+        req.queued_t = time.monotonic()   # re-arm the queue-wait clock
+        self._maybe_promote(req)
         self.running.remove(req)
         self.waiting.appendleft(req)
 
@@ -173,7 +248,16 @@ class Scheduler:
         if req.block_table:
             self.pool.free(req.block_table)
             req.block_table = []
-        req.state = FAILED if reason == "error" else FINISHED
+        if reason in ("eos", "length"):
+            req.state = FINISHED
+        elif reason == "error" or reason == "cancelled":
+            req.state = FAILED
+        else:                       # expired-queue / expired-ttl / drained
+            req.state = EXPIRED
         req.finish_reason = reason
         if req in self.running:
             self.running.remove(req)
+        try:
+            self.waiting.remove(req)
+        except ValueError:
+            pass

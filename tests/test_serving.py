@@ -158,6 +158,68 @@ def test_block_pool_blocks_for():
     assert [pool.blocks_for(n) for n in (1, 16, 17, 32)] == [1, 1, 2, 2]
 
 
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5, 6, 7])
+def test_block_pool_random_interleavings_property(seed):
+    """Property test (hypothesis-style seeded loop): ANY interleaving
+    of allocate / ref / free / preempt-style bulk-free ends with a full
+    free list and zero refcount drift — including orderings the engine
+    never produces today.  A shadow refcount model checks every
+    intermediate state; `check_leaks()` must come back clean after the
+    final teardown."""
+    rng = np.random.RandomState(seed)
+    pool = BlockPool(num_layers=1, num_blocks=16, block_size=4,
+                     num_kv_heads=2, head_dim=8)
+    shadow = {}                 # block id -> refcount (held blocks only)
+    tables = []                 # simulated per-request block tables
+
+    for _ in range(300):
+        op = rng.randint(4)
+        if op == 0:                                   # allocate
+            n = int(rng.randint(1, 5))
+            got = pool.allocate(n)
+            if n > pool.num_blocks - sum(
+                    1 for r in shadow.values() if r > 0):
+                # more than physically free: must refuse, not corrupt
+                assert got is None or len(got) == n
+            if got is None:
+                continue
+            assert len(set(got)) == n
+            assert not any(b in shadow and shadow[b] > 0 for b in got)
+            for b in got:
+                shadow[b] = 1
+            tables.append(list(got))
+        elif op == 1 and tables:                      # ref (share)
+            t = tables[int(rng.randint(len(tables)))]
+            pool.ref(t)
+            tables.append(list(t))
+            for b in t:
+                shadow[b] += 1
+        elif op == 2 and tables:                      # free one table
+            t = tables.pop(int(rng.randint(len(tables))))
+            pool.free(t)
+            for b in t:
+                shadow[b] -= 1
+        elif op == 3 and tables:                      # preempt: bulk free
+            k = int(rng.randint(1, len(tables) + 1))
+            for _ in range(k):
+                t = tables.pop()
+                pool.free(t)
+                for b in t:
+                    shadow[b] -= 1
+        # shadow model and pool must agree at EVERY step
+        held = sum(1 for r in shadow.values() if r > 0)
+        assert pool.free_blocks == pool.num_blocks - held
+        assert pool._refs == [shadow.get(b, 0)
+                              for b in range(pool.num_blocks)]
+        assert all(r >= 0 for r in shadow.values())
+
+    for t in tables:            # teardown: everything goes home
+        pool.free(t)
+    assert pool.check_leaks() == ([], [])
+    assert pool.free_blocks == pool.num_blocks
+    assert sorted(pool._free) == list(range(pool.num_blocks))
+
+
 # ===================================================================
 # preemption and resume mid-decode
 # ===================================================================

@@ -47,8 +47,18 @@ preempt/resume under pressure with every surviving request's output
 token-identical to a sequential ``generate()`` reference, fail only the
 poisoned request, and return every block (zero leaks, whole free list).
 
+``--router`` runs the **serving-tier survival drill** instead: a
+2-replica router where ``serving.replica_kill`` kills one replica
+mid-stream three times (failover re-prefill on the survivor with
+overlap-dedup consistency checks, backoff respawns, then crash-loop
+abandon), an overload burst must shed with structured reasons, and
+``serving.replica_hang`` must be detected via stale heartbeat and
+evicted within the configured timeout — with every surviving request's
+final token stream byte-identical to the uninterrupted sequential
+reference and zero leaked blocks on the survivors.
+
 Usage:  python tools/chaos_check.py [-v] [--mesh-change] [--cold-start]
-        [--serving]
+        [--serving] [--router]
 Exit 0 = all recovery paths green.
 """
 import argparse
@@ -807,6 +817,225 @@ def run_serving(out=None, verbose=False):
     return 0
 
 
+# ============================================================= --router
+def run_router(out=None, verbose=False):
+    """The serving-tier survival drill (three phases over a 2-replica
+    router; one shared tiny GPT so replicas are weight-identical):
+
+    1. **kill + failover + crash-loop**: ``serving.replica_kill`` kills
+       replica r0 mid-stream three times (respawned through the backoff
+       policy between deaths).  Every orphaned request must fail over
+       to r1 and finish with a token stream BYTE-IDENTICAL to the
+       uninterrupted sequential `generate()` reference — the router's
+       failover-overlap dedup must fire (proof the resumed stream was
+       consistency-checked, not blindly trusted), the third death must
+       trip the crash-loop detector (r0 ABANDONED, not burned in
+       restarts), and the survivor's pool must come back leak-free.
+    2. **overload shedding**: with r0 gone, a submission burst against
+       r1's queue-depth watermark must split into fast structured
+       refusals (ShedRequest with reason + gauge detail, nothing
+       allocated) and admitted requests that all complete.
+    3. **hang**: ``serving.replica_hang`` wedges r0 (no stepping, no
+       heartbeat).  The router must detect the stale beat within the
+       configured timeout on its own clock, evict with cause="hang"
+       (NOT "crash"), fail the work over, and still match every
+       reference stream.
+    """
+    out = out if out is not None else sys.stdout
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.resilience.backoff import Backoff
+    from paddle_tpu.serving import LLMEngine, Router, ShedRequest
+    from paddle_tpu.text import GPTConfig, GPTForCausalLM
+    from paddle_tpu.text.generation import generate
+
+    def log(msg):
+        if verbose:
+            print(msg, file=out)
+
+    failures = []
+    reg = metrics.registry()
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, max_position_embeddings=64,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    tensor_parallel=False)
+    model = GPTForCausalLM(cfg)
+    rs = np.random.RandomState(11)
+    prompts = [rs.randint(0, 64, size=n).tolist()
+               for n in (9, 5, 12, 7, 4, 10)]
+    new_tokens = 16
+    refs = [generate(model, paddle.to_tensor(np.asarray([p], "int64")),
+                     max_new_tokens=new_tokens)
+            .numpy()[0, len(p):].tolist() for p in prompts]
+
+    def factory():
+        return LLMEngine(model, num_blocks=24, block_size=4,
+                         max_running=8, prefill_chunk=16,
+                         shed_queue_depth=3)
+
+    def counter(name, **labels):
+        return reg.counter(name, **labels).value
+
+    base = {n: counter(n) for n in (
+        "router_failover_requests_total", "router_failover_dedup_total",
+        "router_failover_token_mismatch_total", "router_respawns_total",
+        "router_crash_loop_aborts_total")}
+    base_evict = {c: counter("router_replica_evicted_total", cause=c)
+                  for c in ("crash", "hang")}
+
+    # ---- phase 1: kill r0 three times -> failover + crash-loop abort --
+    with chaos.scoped("serving.replica_kill@4#r0;"
+                      "serving.replica_kill@6#r0;"
+                      "serving.replica_kill@8#r0"):
+        router = Router(factory, replicas=2, heartbeat_timeout=5.0,
+                        respawn=True,
+                        backoff=Backoff(base=0.001, factor=2.0,
+                                        max_delay=0.01),
+                        crash_loop_threshold=3, crash_loop_window=60.0)
+        reqs = [router.submit(p, max_new_tokens=new_tokens)
+                for p in prompts]
+        router.run(max_steps=100_000)
+    for i, (rr, ref) in enumerate(zip(reqs, refs)):
+        if rr.state != "finished":
+            failures.append(f"kill: request {i} ended "
+                            f"{rr.state}/{rr.finish_reason!r}")
+        elif rr.emitted != ref:
+            failures.append(
+                f"kill: request {i} stream diverged after "
+                f"{rr.failovers} failover(s): {rr.emitted} vs "
+                f"sequential {ref}")
+    n_failover = counter("router_failover_requests_total") \
+        - base["router_failover_requests_total"]
+    n_dedup = counter("router_failover_dedup_total") \
+        - base["router_failover_dedup_total"]
+    n_mismatch = counter("router_failover_token_mismatch_total") \
+        - base["router_failover_token_mismatch_total"]
+    n_crash = counter("router_replica_evicted_total", cause="crash") \
+        - base_evict["crash"]
+    n_respawn = counter("router_respawns_total") \
+        - base["router_respawns_total"]
+    n_abort = counter("router_crash_loop_aborts_total") \
+        - base["router_crash_loop_aborts_total"]
+    if n_failover < 1:
+        failures.append("kill: no request ever failed over — the kill "
+                        "missed every in-flight stream")
+    if n_dedup < 1:
+        failures.append(
+            "kill: failover dedup never fired — no stream was killed "
+            "MID-token (resume started before any emission)")
+    if n_mismatch:
+        failures.append(f"kill: {n_mismatch} failover overlap token(s) "
+                        f"MISMATCHED the already-emitted stream")
+    if n_crash != 3 or n_respawn != 2 or n_abort != 1:
+        failures.append(
+            f"kill: evictions/respawns/aborts = {n_crash}/{n_respawn}/"
+            f"{n_abort}, want 3/2/1 (three deaths, two backoff "
+            f"respawns, then the crash-loop detector must abandon)")
+    states = {s.name: s.state for s in router._slots}
+    if states.get("r0") != "abandoned":
+        failures.append(f"kill: r0 state {states.get('r0')!r} after 3 "
+                        f"crashes, want 'abandoned'")
+    log(f"phase 1 (kill x3): {n_failover} failover(s), {n_dedup} "
+        f"dedup(s), {n_crash} evictions, {n_respawn} respawns, "
+        f"{n_abort} crash-loop abort; streams identical")
+
+    # ---- phase 2: overload burst against the survivor's watermark ----
+    base_shed = counter("serving_requests_shed_total",
+                        reason="queue_depth")
+    admitted, shed = [], []
+    for i in range(10):
+        try:
+            admitted.append(router.submit(prompts[i % len(prompts)],
+                                          max_new_tokens=4))
+        except ShedRequest as e:
+            shed.append(e)
+    router.run(max_steps=100_000)
+    if not shed:
+        failures.append("shed: burst past the queue-depth watermark "
+                        "was never refused")
+    for e in shed:
+        if e.reason != "queue_depth" or "queue_depth" not in e.detail:
+            failures.append(f"shed: refusal not structured: "
+                            f"reason={e.reason!r} detail={e.detail}")
+            break
+    d_shed = counter("serving_requests_shed_total",
+                     reason="queue_depth") - base_shed
+    if d_shed != len(shed):
+        failures.append(f"shed: counter saw {d_shed} refusals, router "
+                        f"raised {len(shed)}")
+    for i, rr in enumerate(admitted):
+        if rr.state != "finished":
+            failures.append(f"shed: admitted burst request {i} ended "
+                            f"{rr.state}/{rr.finish_reason!r}")
+    leaks = router.close()
+    for name, (leaked, bad) in leaks.items():
+        if leaked or bad:
+            failures.append(f"survivor {name} pool leaked: rc>0 "
+                            f"{leaked}, rc<0 {bad}")
+    log(f"phase 2 (overload burst): {len(admitted)} admitted + "
+        f"{len(shed)} shed with structured reasons; survivor leak-free")
+
+    # ---- phase 3: hang -> stale heartbeat -> evict within timeout ----
+    hb_timeout = 0.3
+    with chaos.scoped("serving.replica_hang@3#r0"):
+        router2 = Router(factory, replicas=2,
+                         heartbeat_timeout=hb_timeout, respawn=False)
+        reqs2 = [router2.submit(p, max_new_tokens=new_tokens)
+                 for p in prompts[:4]]
+        t0 = time.monotonic()
+        router2.run(max_steps=1_000_000)
+    hangs = [e for e in router2.events
+             if e["event"] == "evict" and e["cause"] == "hang"]
+    crashes = [e for e in router2.events
+               if e["event"] == "evict" and e["cause"] == "crash"]
+    if len(hangs) != 1 or crashes:
+        failures.append(f"hang: evictions hang={len(hangs)} "
+                        f"crash={len(crashes)}, want exactly one HANG "
+                        f"(stale beat), zero crashes")
+    else:
+        # detection must land within the timeout (+ scheduling slack)
+        silent = hangs[0].get("silent_for")
+        if silent is None or silent > hb_timeout + 1.0:
+            failures.append(
+                f"hang: evicted after {silent!r}s of silence, want "
+                f"within timeout {hb_timeout}s (+1s step slack)")
+    for i, (rr, ref) in enumerate(zip(reqs2, refs[:4])):
+        if rr.state != "finished" or rr.emitted != ref:
+            failures.append(
+                f"hang: request {i} {rr.state}/{rr.finish_reason!r} "
+                f"stream {'ok' if rr.emitted == ref else 'DIVERGED'}")
+    leaks2 = router2.close()
+    for name, (leaked, bad) in leaks2.items():
+        if leaked or bad:
+            failures.append(f"hang survivor {name} pool leaked: "
+                            f"rc>0 {leaked}, rc<0 {bad}")
+    log(f"phase 3 (hang): stale beat detected after "
+        f"{hangs[0]['silent_for']:.3f}s (timeout {hb_timeout}s), "
+        f"evicted as hang, streams identical" if hangs else
+        "phase 3 (hang): FAILED")
+
+    if failures:
+        print("chaos_check --router FAILED:", file=out)
+        for f in failures:
+            print(f"  - {f}", file=out)
+        return 1
+    print(f"chaos_check --router OK: replica killed 3x mid-stream -> "
+          f"{n_failover} failover(s) with overlap-dedup consistency "
+          f"checks, 2 backoff respawns + crash-loop abandon; overload "
+          f"burst shed {len(shed)} request(s) with structured reasons; "
+          f"hung replica evicted via stale heartbeat within "
+          f"{hb_timeout}s; every surviving stream byte-identical to "
+          f"the sequential reference, zero leaked blocks on survivors",
+          file=out)
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("-v", "--verbose", action="store_true")
@@ -825,6 +1054,15 @@ def main(argv=None):
                          "preempted requests must finish token-identical "
                          "to sequential generate() with zero block "
                          "leaks) instead of the 4-family plan")
+    ap.add_argument("--router", action="store_true",
+                    help="run the serving-tier survival drill (2-replica "
+                         "router; replica killed 3x mid-stream -> "
+                         "failover re-prefill + crash-loop abandon, "
+                         "overload burst -> structured shedding, hung "
+                         "replica -> stale-heartbeat eviction; all "
+                         "surviving streams must be byte-identical to "
+                         "the sequential reference) instead of the "
+                         "4-family plan")
     ap.add_argument("--cold-start-worker", action="store_true",
                     help=argparse.SUPPRESS)   # the drill's restarted proc
     ap.add_argument("--cache-dir", help=argparse.SUPPRESS)
@@ -832,6 +1070,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.cold_start_worker:
         return run_cold_worker(args.cache_dir, args.ckpt_root)
+    if args.router:
+        return run_router(verbose=args.verbose)
     if args.serving:
         return run_serving(verbose=args.verbose)
     if args.cold_start:

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""serve — a thin driver over paddle_tpu.serving.LLMEngine.
+"""serve — a driver over paddle_tpu.serving (engine or router mode).
 
 Builds a model, feeds it requests, streams tokens as they decode, and
 prints the serving metrics snapshot when the queue drains.  Requests
@@ -12,13 +12,27 @@ or ``--random N`` synthetic prompts.
     # a real preset, AOT warm start from a prior --export-aot run
     python tools/serve.py --preset gpt3-125M --load-aot /tmp/aot < ids.txt
 
+    # the serving tier: 2 replicas behind the router (least-loaded
+    # admission, heartbeat health, failover re-prefill, load shedding)
+    python tools/serve.py --random 12 --replicas 2
+
 ``--export-aot DIR`` writes the replica's per-bucket AOT artifacts
-(serving.aot) after the run, so the next replica starts zero-compile.
-See docs/serving.md.
+(serving.aot) after the run, so the next replica starts zero-compile;
+in router mode ``--load-aot`` warm-starts every replica AND every
+respawned replacement.  Watermark/deadline knobs (``--shed-queue-depth``,
+``--shed-free-blocks``, ``--queue-deadline``, ``--ttl``) arm the
+admission-control story from docs/serving.md.
+
+**Graceful shutdown**: SIGTERM (or SIGINT) follows the
+CheckpointManager preemption-flush pattern — the handler only records
+the signal; the drive loop then stops admitting, drains in-flight
+requests (finish, or expire past ``--drain-ttl``), flushes a final
+metrics snapshot to stderr, and frees the pool(s).
 """
 import argparse
 import json
 import os
+import signal
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -26,7 +40,7 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 
-def main(argv=None):
+def build_parser():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--preset", default=None,
                     help="GPTConfig preset (default: a tiny demo config)")
@@ -42,13 +56,51 @@ def main(argv=None):
     ap.add_argument("--block-size", type=int, default=16)
     ap.add_argument("--max-running", type=int, default=8)
     ap.add_argument("--prefill-chunk", type=int, default=64)
+    ap.add_argument("--replicas", type=int, default=1, metavar="N",
+                    help="N>1 serves through the multi-replica Router "
+                         "(in-process replicas; a production tier runs "
+                         "one serve.py per replica)")
+    ap.add_argument("--heartbeat-timeout", type=float, default=5.0,
+                    help="router: stale-beat seconds before a replica "
+                         "is evicted as hung")
+    ap.add_argument("--shed-queue-depth", type=int, default=None,
+                    help="admission watermark: shed when this many "
+                         "requests are already queued")
+    ap.add_argument("--shed-free-blocks", type=int, default=None,
+                    help="admission watermark: shed when free blocks "
+                         "drop below this with a backlog queued")
+    ap.add_argument("--queue-deadline", type=float, default=None,
+                    help="per-request max queue wait (s) before clean "
+                         "expiry")
+    ap.add_argument("--ttl", type=float, default=None,
+                    help="per-request total lifetime (s) before clean "
+                         "expiry")
+    ap.add_argument("--drain-ttl", type=float, default=30.0,
+                    help="graceful-shutdown budget (s) for in-flight "
+                         "requests after SIGTERM")
     ap.add_argument("--export-aot", metavar="DIR", default=None,
                     help="write per-bucket AOT artifacts after the run")
     ap.add_argument("--load-aot", metavar="DIR", default=None,
                     help="warm-start from exported AOT artifacts")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="no per-token streaming output")
-    args = ap.parse_args(argv)
+    return ap
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    # graceful shutdown: install the RECORDING handler before the heavy
+    # imports/compiles, so a SIGTERM during startup still drains instead
+    # of hard-killing (the CheckpointManager preemption-flush pattern —
+    # the handler only records; the drive loop does the work)
+    stop = {"sig": None}
+
+    def _on_signal(signum, frame):
+        stop["sig"] = signum
+
+    prev = {s: signal.signal(s, _on_signal)
+            for s in (signal.SIGTERM, signal.SIGINT)}
 
     import numpy as np
     import paddle_tpu as pt
@@ -69,14 +121,31 @@ def main(argv=None):
     with pt.LazyGuard():
         model = GPTForCausalLM(cfg)
 
-    eng = serving.LLMEngine(model, num_blocks=args.num_blocks,
-                            block_size=args.block_size,
-                            max_running=args.max_running,
-                            prefill_chunk=args.prefill_chunk)
+    def engine_factory():
+        return serving.LLMEngine(
+            model, num_blocks=args.num_blocks,
+            block_size=args.block_size, max_running=args.max_running,
+            prefill_chunk=args.prefill_chunk,
+            shed_queue_depth=args.shed_queue_depth,
+            shed_free_blocks=args.shed_free_blocks)
+
+    warm_start = None
     if args.load_aot:
-        keys = serving.load_serving_artifacts(eng, args.load_aot)
-        print(f"# AOT warm start: loaded {len(keys)} program(s)",
-              file=sys.stderr)
+        def warm_start(eng):
+            keys = serving.load_serving_artifacts(eng, args.load_aot)
+            print(f"# AOT warm start: loaded {len(keys)} program(s)",
+                  file=sys.stderr)
+
+    router = None
+    if args.replicas > 1:
+        backend = router = serving.Router(
+            engine_factory, replicas=args.replicas,
+            heartbeat_timeout=args.heartbeat_timeout,
+            warm_start=warm_start)
+    else:
+        backend = engine_factory()
+        if warm_start is not None:
+            warm_start(backend)
 
     if args.random:
         rs = np.random.RandomState(0)
@@ -96,29 +165,64 @@ def main(argv=None):
             print(f"req{req.id} +{tok}", flush=True)
 
     def on_finish(req):
+        toks = req.emitted if router is not None else req.generated
         print(f"req{req.id} DONE ({req.finish_reason}): "
-              f"{' '.join(map(str, req.generated))}", flush=True)
+              f"{' '.join(map(str, toks))}", flush=True)
 
-    for p in prompts:
-        eng.add_request(p, max_new_tokens=args.max_new_tokens,
-                        eos_token_id=args.eos, do_sample=args.do_sample,
-                        temperature=args.temperature, top_k=args.top_k,
-                        top_p=args.top_p, on_token=on_token,
-                        on_finish=on_finish)
-    steps = eng.run()
+    kw = dict(max_new_tokens=args.max_new_tokens,
+              do_sample=args.do_sample, temperature=args.temperature,
+              top_k=args.top_k, top_p=args.top_p, on_token=on_token,
+              on_finish=on_finish, queue_deadline_s=args.queue_deadline,
+              ttl_s=args.ttl)
+    shed = 0
+    try:
+        for p in prompts:
+            if stop["sig"] is not None:
+                break                # stop admitting the moment we're told
+            try:
+                if router is not None:
+                    router.submit(p, eos_token_id=args.eos, **kw)
+                else:
+                    backend.add_request(p, eos_token_id=args.eos, **kw)
+            except serving.ShedRequest as e:
+                shed += 1
+                print(f"req SHED ({e.reason}): {e.detail}", flush=True)
+        steps = 0
+        while backend.has_work and stop["sig"] is None:
+            backend.step()
+            steps += 1
 
-    if args.export_aot:
-        serving.export_serving_artifacts(
-            eng, args.export_aot, prompt_lens=[len(p) for p in prompts])
-        print(f"# AOT artifacts exported to {args.export_aot}",
-              file=sys.stderr)
+        if stop["sig"] is not None:
+            print(f"# signal {stop['sig']}: draining in-flight requests "
+                  f"(budget {args.drain_ttl:g}s)", file=sys.stderr)
+            backend.drain(ttl_s=args.drain_ttl)
 
-    reg = metrics.registry()
-    snap = {m["name"]: m.get("value", m.get("count"))
-            for m in reg.snapshot()
-            if m["name"].startswith("serving_")}
-    print(json.dumps({"steps": steps, "requests": len(prompts),
-                      "metrics": snap}, indent=1), file=sys.stderr)
+        if args.export_aot:
+            if router is not None:
+                print("# --export-aot ignored in router mode (export "
+                      "from a single-engine run, then --load-aot the "
+                      "tier)", file=sys.stderr)
+            else:
+                serving.export_serving_artifacts(
+                    backend, args.export_aot,
+                    prompt_lens=[len(p) for p in prompts])
+                print(f"# AOT artifacts exported to {args.export_aot}",
+                      file=sys.stderr)
+    finally:
+        for s, h in prev.items():
+            signal.signal(s, h)
+        # final metrics snapshot BEFORE freeing the pool(s)
+        reg = metrics.registry()
+        snap = {m["name"]: m.get("value", m.get("count"))
+                for m in reg.snapshot()
+                if m["name"].startswith(("serving_", "router_"))}
+        leaks = backend.close()
+        print(json.dumps({
+            "requests": len(prompts), "shed": shed,
+            "drained": stop["sig"] is not None,
+            "leaks": (leaks if router is not None
+                      else {"r0": leaks}), "metrics": snap,
+        }, indent=1, default=str), file=sys.stderr)
     return 0
 
 
