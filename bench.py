@@ -517,14 +517,21 @@ def run_serving_router(preset="gpt3-125M", replicas=2, n_requests=24,
                        new_tokens=32, num_blocks=None, block_size=16,
                        max_running=8, seed=0, burst_factor=6.0,
                        burst_requests=64, shed_queue_depth=None,
-                       **cfg_kw):
+                       proc=False, **cfg_kw):
     """Router leg: the SAME seeded Poisson trace through the
     multi-replica Router (replicas warm-started from per-bucket AOT
     artifacts, so scale-out adds zero compiles) vs one engine, then an
     overload burst (arrival rate x `burst_factor`) with watermark
     shedding armed — routed TTFT/TPOT p50/p99 and the shed rate are the
     serving-tier acceptance numbers (fast refusals, bounded p99,
-    instead of unbounded queue growth)."""
+    instead of unbounded queue growth).
+
+    ``proc=True`` runs the router legs over PROCESS-per-replica workers
+    (serving.worker.ProcReplica over the framed socket transport; each
+    worker builds its own copy of the model from the spec and AOT-warm-
+    starts from the same exported artifacts).  Expect parity with the
+    in-proc tier on CPU — this leg exists to catch transport overhead
+    regressions (framing, event streaming, RPC latency), not to win."""
     import shutil
     import tempfile
 
@@ -600,14 +607,46 @@ def run_serving_router(preset="gpt3-125M", replicas=2, n_requests=24,
         def warm(eng):
             load_serving_artifacts(eng, aot_dir)
 
+        def make_router(shed=None):
+            """The tier under test: in-proc replicas by default, real
+            worker processes (same AOT artifacts, same trace) under
+            ``proc`` — one code path per transport, one bench."""
+            if not proc:
+                if shed is None:
+                    return Router(factory, replicas=replicas,
+                                  heartbeat_timeout=30.0,
+                                  warm_start=warm)
+                return Router(
+                    lambda: factory(shed_queue_depth=shed),
+                    replicas=replicas, heartbeat_timeout=30.0,
+                    warm_start=warm)
+            from paddle_tpu.serving import worker as sw
+            eng_kw = dict(num_blocks=num_blocks, block_size=block_size,
+                          max_running=max_running, prefill_chunk=64)
+            if shed is not None:
+                eng_kw["shed_queue_depth"] = shed
+            spec = sw.gpt_spec(
+                preset=preset,
+                overrides=dict(vocab_size=50304,
+                               max_position_embeddings=max_len,
+                               hidden_dropout=0.0,
+                               attention_dropout=0.0,
+                               tensor_parallel=False, **cfg_kw),
+                seed=0, engine=eng_kw, load_aot=aot_dir, lazy=True)
+            r = Router(None, replicas=replicas, heartbeat_timeout=30.0,
+                       spawn_grace_s=600.0,
+                       replica_factory=lambda name, hb, respawning=False:
+                       sw.ProcReplica(spec, name, hb))
+            r.wait_ready(timeout=600.0)
+            return r
+
         # ---- leg A: one engine, the trace -----------------------------
         eng_run = drive(
             lambda p: one.add_request(p, max_new_tokens=new_tokens),
             one, arrivals, prompts)
 
         # ---- leg B: the router over N warm replicas, same trace -------
-        router = Router(factory, replicas=replicas,
-                        heartbeat_timeout=30.0, warm_start=warm)
+        router = make_router()
         rt_run = drive(
             lambda p: router.submit(p, max_new_tokens=new_tokens),
             router, arrivals, prompts)
@@ -621,9 +660,7 @@ def run_serving_router(preset="gpt3-125M", replicas=2, n_requests=24,
                          .tolist() for _ in range(burst_requests)]
         burst_arrivals = np.cumsum(
             rs.exponential(1.0 / burst_rate, burst_requests))
-        shed_router = Router(
-            lambda: factory(shed_queue_depth=shed_queue_depth),
-            replicas=replicas, heartbeat_timeout=30.0, warm_start=warm)
+        shed_router = make_router(shed=shed_queue_depth)
         burst = drive(
             lambda p: shed_router.submit(p, max_new_tokens=new_tokens),
             shed_router, burst_arrivals, burst_prompts)
@@ -632,7 +669,7 @@ def run_serving_router(preset="gpt3-125M", replicas=2, n_requests=24,
         shutil.rmtree(aot_dir, ignore_errors=True)
 
     return {
-        "replicas": replicas,
+        "replicas": replicas, "proc": bool(proc),
         "tps_one": eng_run["tokens"] / eng_run["dt"],
         "tps_router": rt_run["tokens"] / rt_run["dt"],
         "speedup": (rt_run["tokens"] / rt_run["dt"])
@@ -648,7 +685,9 @@ def run_serving_router(preset="gpt3-125M", replicas=2, n_requests=24,
             "shed": burst["shed"],
             "shed_rate": burst["shed"] / burst_requests,
             "admitted_ttft_p99_s": round(pct(burst["ttft"], 99), 4),
-            "leak_free": all(not l and not b
+            # strict ==[]: a proc worker that never reported returns
+            # (None, None) — unknown must not read as leak-free
+            "leak_free": all(l == [] and b == []
                              for l, b in leaks.values()),
         },
         "n_requests": n_requests, "new_tokens": new_tokens,
@@ -1007,10 +1046,13 @@ def main():
         # ROUTER leg instead: same trace through the serving tier vs
         # one engine + an overload burst with watermark shedding
         # (ISSUE 11 acceptance numbers: routed TTFT/TPOT p50/p99 and
-        # the shed rate).
+        # the shed rate).  `--proc` runs the router legs over REAL
+        # worker processes (the ISSUE 12 transport-overhead check:
+        # expect parity with in-proc on CPU).
         replicas = 1
         if "--replicas" in sys.argv:
             replicas = int(sys.argv[sys.argv.index("--replicas") + 1])
+        proc = "--proc" in sys.argv
         tiny = os.environ.get("JAX_PLATFORMS", "") == "cpu" or \
             os.environ.get("BENCH_FORCE_CPU") == "1"
         kw = dict(preset="gpt3-125M")
@@ -1018,14 +1060,17 @@ def main():
             kw = dict(preset="gpt3-125M", hidden_size=64, num_layers=2,
                       num_heads=4, n_requests=12, arrival_rate=20.0,
                       prompt_lo=8, prompt_hi=48, new_tokens=16)
-        if replicas > 1:
-            res = run_serving_router(replicas=replicas, **kw)
+        if replicas > 1 or proc:
+            res = run_serving_router(replicas=max(replicas, 2),
+                                     proc=proc, **kw)
             print(json.dumps({
-                "metric": "multi-replica router serving tokens/sec",
+                "metric": ("process-per-replica router serving "
+                           "tokens/sec" if proc else
+                           "multi-replica router serving tokens/sec"),
                 "value": round(res["tps_router"], 1),
                 "vs_baseline": round(res["speedup"], 3), **{
                     k: res[k] for k in (
-                        "replicas", "tps_one", "ttft_p50_s",
+                        "replicas", "proc", "tps_one", "ttft_p50_s",
                         "ttft_p99_s", "tpot_p50_s", "tpot_p99_s",
                         "one_ttft_p99_s", "burst")}}))
             return

@@ -75,13 +75,31 @@ class BeatWatch:
     monotonic clock (the launch-supervisor rule: a wall-clock step /
     NTP jump must never declare a whole fleet hung at once).  A fresh
     watch starts its clock at construction, so a just-(re)spawned
-    worker gets a full timeout of grace before it must beat."""
+    worker gets a full timeout of grace before it must beat.
 
-    def __init__(self, path, timeout, clock=time.monotonic):
+    ``grace`` widens that spawn window: until this watch observes its
+    first beat, the allowed silence is ``max(timeout, grace)`` instead
+    of ``timeout`` — a worker *process* that spends tens of seconds
+    importing and compiling before its first beat must not be evicted
+    as hung while it starts.  The file's state AT CONSTRUCTION is the
+    baseline, not a beat: a leftover heartbeat file from the slot's
+    previous (dead) worker cannot disarm the new worker's grace — only
+    a fresh mtime CHANGE does, after which the plain timeout applies.
+    The caller re-arms grace by constructing a fresh watch at every
+    (re)spawn, which is exactly what the router does."""
+
+    def __init__(self, path, timeout, clock=time.monotonic, grace=None):
         self.path = path
         self.timeout = float(timeout)
+        self.grace = self.timeout if grace is None else float(grace)
         self._clock = clock
-        self._last_mtime = None
+        try:
+            # baseline only — a dead predecessor's leftover file must
+            # not look like a live beat to the fresh watch
+            self._last_mtime = os.stat(path).st_mtime
+        except OSError:
+            self._last_mtime = None
+        self._seen_beat = False
         self._last_change = clock()
 
     @property
@@ -90,7 +108,8 @@ class BeatWatch:
 
     def stale(self):
         """True when the file hasn't changed for longer than `timeout`
-        on this watcher's clock."""
+        on this watcher's clock (``max(timeout, grace)`` until this
+        watch observes its first beat)."""
         now = self._clock()
         try:
             mtime = os.stat(self.path).st_mtime
@@ -99,8 +118,11 @@ class BeatWatch:
         if mtime is not None and mtime != self._last_mtime:
             self._last_mtime = mtime
             self._last_change = now
+            self._seen_beat = True
             return False
-        return now - self._last_change > self.timeout
+        limit = self.timeout if self._seen_beat \
+            else max(self.timeout, self.grace)
+        return now - self._last_change > limit
 
 
 def start_heartbeat(path=None, interval=None):

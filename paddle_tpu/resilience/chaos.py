@@ -66,6 +66,13 @@ Fault sites (see docs/resilience.md for the full table):
                                 detect the stale beat within the
                                 configured timeout and evict it as a
                                 hang (distinct from a crash)
+    serving.transport_drop      a frame on the process-per-replica
+                                socket transport is dropped in transit
+                                (torn in flight) — the receiver must
+                                reject the stream structurally
+                                (FrameError), and the router must turn
+                                that into a crash eviction + failover
+                                re-prefill, never a silent token gap
 
 Zero-cost when disabled: every site guards on the module-level
 ``_PLAN is None`` check before doing any work.

@@ -17,7 +17,16 @@ KV cache (the ROADMAP "millions of users" serving layer).
                 admission + session affinity, heartbeat health (stale
                 beat = hang, raise = crash), failover re-prefill with
                 router-side dedup, backoff respawn with crash-loop
-                abort, two-level load shedding (ShedRequest)
+                abort, two-level load shedding (ShedRequest).  Drives
+                replicas through ONE ReplicaHandle interface
+    transport   length-prefixed framed RPC (FrameDecoder/Channel) +
+                TransportPolicy (the PR-6 timeout/retry/backoff shape)
+                for the process-per-replica tier
+    worker      the real-process replica: `python -m
+                paddle_tpu.serving.worker` runs the engine step loop in
+                its own process; ProcReplica is the parent-side handle
+                (waitpid crash detection, heartbeat hang detection,
+                TERM→KILL orphan reaping)
 
 The decode hot path is the `paged_attention` op: a pallas TPU kernel
 (ops/pallas/paged_attention.py) streaming pool blocks through each
@@ -29,12 +38,22 @@ from __future__ import annotations
 from .block_pool import BlockPool, PoolExhausted  # noqa: F401
 from .scheduler import Request, Scheduler  # noqa: F401
 from .engine import LLMEngine, ShedRequest  # noqa: F401
-from .router import EngineReplica, RoutedRequest, Router  # noqa: F401
+from .router import (  # noqa: F401
+    EngineReplica, ReplicaGone, ReplicaHandle, RoutedRequest, Router,
+)
+from .transport import (  # noqa: F401
+    ChannelClosed, FrameError, TransportError, TransportPolicy,
+    TransportTimeout,
+)
+from .worker import ProcReplica, RemoteRequest, WorkerDied  # noqa: F401
 from .aot import (  # noqa: F401
     export_serving_artifacts, load_serving_artifacts,
 )
 
 __all__ = ["BlockPool", "PoolExhausted", "Request", "Scheduler",
            "LLMEngine", "ShedRequest", "Router", "RoutedRequest",
-           "EngineReplica", "export_serving_artifacts",
-           "load_serving_artifacts"]
+           "ReplicaHandle", "ReplicaGone", "EngineReplica",
+           "ProcReplica", "RemoteRequest", "WorkerDied",
+           "TransportError", "TransportPolicy", "TransportTimeout",
+           "FrameError", "ChannelClosed",
+           "export_serving_artifacts", "load_serving_artifacts"]

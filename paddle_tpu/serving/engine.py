@@ -185,6 +185,18 @@ class LLMEngine:
     def has_work(self):
         return bool(self.scheduler.waiting or self.scheduler.running)
 
+    def metrics_snapshot(self, prefix="serving_"):
+        """Point-in-time snapshot of this replica's serving metrics —
+        the registry records whose name starts with `prefix` (a str or
+        a tuple of strs).  JSON-serializable by construction: this is
+        the payload of the process-per-replica ``metrics_snapshot``
+        RPC, and what `tools/serve.py --proc` merges into its final
+        report (each worker process owns its own registry)."""
+        if isinstance(prefix, str):
+            prefix = (prefix,)
+        return [rec for rec in self._reg.snapshot()
+                if rec["name"].startswith(tuple(prefix))]
+
     def run(self, max_steps=None):
         """Drive step() until the queues drain (or max_steps)."""
         n = 0

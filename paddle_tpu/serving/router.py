@@ -39,15 +39,31 @@ watermarks (`ShedRequest`, a structured refusal), and the router sheds
 when every healthy replica refuses — fast refusals with reasons
 instead of unbounded p99.
 
-This module is deliberately in-process (replica = engine + heartbeat
-file + chaos-killable step driver): the same state machine drives a
-process-per-replica deployment, where "crash" arrives as an exit code
-instead of an exception and `tools/serve.py` runs one replica per
-process — see docs/serving.md "Router & failover".
+The router drives replicas through ONE interface —
+:class:`ReplicaHandle` — with two implementations and **no
+transport-specific branches** in the router itself:
+
+* :class:`EngineReplica` (the default): in-process, replica = engine +
+  heartbeat file + chaos-killable step driver.  Cheap, deterministic,
+  what CPU tier-1 runs.
+* ``serving.worker.ProcReplica``: a real OS process running the engine
+  step loop behind the framed socket transport
+  (`serving/transport.py`).  A segfault, OOM-kill, or ``kill -9``
+  there is a *crash* (waitpid exit code → ``step()`` raises), a wedged
+  XLA call is a *hang* (the worker beats its heartbeat file from
+  inside its loop, so silence is staleness) — both land in exactly
+  the eviction machinery above.  Pass ``replica_factory=`` to install
+  it; ``spawn_grace_s`` widens the heartbeat grace window until a
+  fresh worker's FIRST beat (a worker importing + compiling for tens
+  of seconds must not be read as hung).
 
 Chaos sites: ``serving.replica_kill`` (the replica's step raises, as a
-dead process would) and ``serving.replica_hang`` (the replica stops
-stepping AND beating).  ``tools/chaos_check.py --router`` is the drill.
+dead process would), ``serving.replica_hang`` (the replica stops
+stepping AND beating), and ``serving.transport_drop`` (a frame is
+dropped in transit — the transport rejects the stream structurally
+and the replica is evicted as a crash).  ``tools/chaos_check.py
+--router`` is the in-process drill; ``--router --proc`` kills real
+worker processes with SIGKILL.
 """
 from __future__ import annotations
 
@@ -71,7 +87,82 @@ RESPAWNING = "respawning"  # evicted, respawn scheduled (backoff)
 ABANDONED = "abandoned"    # crash-looping: restarts cannot help
 
 
-class EngineReplica:
+class ReplicaGone(RuntimeError):
+    """A replica died WHILE the router was talking to it (its process
+    exited, its transport tore or timed out).  Raised by ReplicaHandle
+    methods; the router turns it into the same crash eviction a raise
+    from ``step()`` produces, then retries placement on survivors."""
+
+
+class ReplicaHandle:
+    """The uniform contract the Router drives a replica through.  Two
+    implementations: :class:`EngineReplica` (in-process, the default)
+    and ``serving.worker.ProcReplica`` (a spawned worker process over
+    the framed socket transport).  The router holds no
+    transport-specific branches — every abnormal condition surfaces as
+    either a raise from ``step()``/``add_request()`` (→ crash eviction
+    / re-placement, :class:`ReplicaGone` included) or a stale
+    heartbeat file (→ hang eviction)."""
+
+    name = "?"
+
+    def step(self):
+        """One driver iteration.  Returns the engine step summary dict
+        (or None when idle); a raise means the replica crashed."""
+        raise NotImplementedError
+
+    def add_request(self, prompt_ids, **kw):
+        """Queue one request; returns a request handle whose
+        ``generated`` list (seeded with any resume tokens, so its
+        length is the absolute stream position) and ``finish_reason``
+        the router reads.  Raises ShedRequest / ValueError /
+        PoolExhausted like the engine, or ReplicaGone when the replica
+        died mid-call."""
+        raise NotImplementedError
+
+    def cancel(self, req):
+        """Best-effort abort of a queued/running request."""
+        raise NotImplementedError
+
+    def load(self):
+        """Load score tuple from the engine's own gauges:
+        (queue_depth, running, -free_blocks) — lower is less loaded."""
+        raise NotImplementedError
+
+    def beat(self):
+        """Arm the heartbeat file (spawn-time).  Replicas that beat
+        from their own loop (worker processes) leave this a no-op and
+        rely on the spawn grace window instead."""
+
+    def wait_ready(self, timeout=None):
+        """Block until the replica can accept work (True), or the
+        timeout expires (False).  In-process replicas are born ready;
+        a worker process becomes ready once it has imported, built its
+        engine and loaded any AOT artifacts — until then
+        ``add_request`` sheds with reason ``replica_warming``."""
+        return True
+
+    def metrics_snapshot(self):
+        """This replica's serving_* metrics records (the engine
+        snapshot API; an RPC for worker replicas)."""
+        return []
+
+    def drain(self, ttl_s=None):
+        """Engine-level graceful drain; returns its summary dict."""
+        return {}
+
+    def abort(self):
+        """Evicted (crash or hang): tear the replica down NOW — for a
+        worker process, TERM→KILL escalation plus reap, so no orphan
+        survives the router.  Must never raise."""
+
+    def close(self):
+        """Graceful release; returns the engine's ``check_leaks()``
+        tuple (or (None, None) when the replica could not report)."""
+        return None
+
+
+class EngineReplica(ReplicaHandle):
     """One in-process replica: an engine plus the liveness contract —
     beat the heartbeat file every *scheduler-loop* iteration.  The
     chaos sites live here because this is the process boundary a real
@@ -102,6 +193,30 @@ class EngineReplica:
         if self.engine.has_work:
             return self.engine.step()
         return None
+
+    # ------------------------------------------- ReplicaHandle interface
+    def beat(self):
+        self.heartbeat.beat()
+
+    def add_request(self, prompt_ids, **kw):
+        return self.engine.add_request(prompt_ids, **kw)
+
+    def cancel(self, req):
+        self.engine.cancel(req)
+
+    def load(self):
+        eng = self.engine
+        return (eng.scheduler.queue_depth, len(eng.scheduler.running),
+                -eng.pool.free_blocks)
+
+    def metrics_snapshot(self):
+        return self.engine.metrics_snapshot()
+
+    def drain(self, ttl_s=None):
+        return self.engine.drain(ttl_s=ttl_s)
+
+    def close(self):
+        return self.engine.close()
 
 
 class _ReplicaSlot:
@@ -166,8 +281,20 @@ class Router:
     def __init__(self, engine_factory, replicas=2, heartbeat_timeout=5.0,
                  heartbeat_dir=None, respawn=True, backoff=None,
                  crash_loop_threshold=3, crash_loop_window=60.0,
-                 failover_overlap=1, warm_start=None):
+                 failover_overlap=1, warm_start=None,
+                 replica_factory=None, spawn_grace_s=None):
         self._factory = engine_factory
+        # replica_factory(name, hb_path, respawning=) -> ReplicaHandle
+        # replaces the default in-process EngineReplica build — how a
+        # process-per-replica tier installs serving.worker.ProcReplica
+        # (engine_factory/warm_start are then unused and may be None)
+        self._replica_factory = replica_factory
+        # grace window for a replica's FIRST heartbeat after (re)spawn:
+        # a worker process importing + compiling must not be evicted as
+        # hung before it ever had a chance to beat (None = the plain
+        # heartbeat timeout, the in-process behavior)
+        self.spawn_grace_s = (None if spawn_grace_s is None
+                              else float(spawn_grace_s))
         self.heartbeat_timeout = float(heartbeat_timeout)
         self._own_hb_dir = heartbeat_dir is None
         self.hb_dir = heartbeat_dir or tempfile.mkdtemp(
@@ -204,19 +331,25 @@ class Router:
 
     # ------------------------------------------------------------ replicas
     def _spawn(self, slot, respawning=False):
-        engine = self._factory()
-        if self._warm_start is not None:
-            try:
-                self._warm_start(engine)
-                if respawning:
-                    self._reg.counter(
-                        "router_respawn_warm_start_total").inc()
-            except Exception as e:   # warm start is best-effort
-                warnings.warn(f"router replica {slot.name} warm start "
-                              f"failed ({e}); starting cold", UserWarning)
-        slot.handle = EngineReplica(slot.name, engine, slot.hb_path)
-        slot.watch = hb.BeatWatch(slot.hb_path, self.heartbeat_timeout)
-        slot.handle.heartbeat.beat()   # live file before any staleness
+        if self._replica_factory is not None:
+            slot.handle = self._replica_factory(slot.name, slot.hb_path,
+                                                respawning=respawning)
+        else:
+            engine = self._factory()
+            if self._warm_start is not None:
+                try:
+                    self._warm_start(engine)
+                    if respawning:
+                        self._reg.counter(
+                            "router_respawn_warm_start_total").inc()
+                except Exception as e:   # warm start is best-effort
+                    warnings.warn(f"router replica {slot.name} warm "
+                                  f"start failed ({e}); starting cold",
+                                  UserWarning)
+            slot.handle = EngineReplica(slot.name, engine, slot.hb_path)
+            slot.handle.beat()     # live file before any staleness
+        slot.watch = hb.BeatWatch(slot.hb_path, self.heartbeat_timeout,
+                                  grace=self.spawn_grace_s)
         slot.state = HEALTHY
         if respawning:
             slot.respawns += 1
@@ -238,7 +371,15 @@ class Router:
         orphans = [rr for rr in self._requests
                    if rr.state == "live" and rr.slot is slot]
         # the dead replica's pool dies with it (in a real deployment the
-        # process is gone) — leak accounting applies to SURVIVORS
+        # process is gone) — leak accounting applies to SURVIVORS.
+        # abort() makes "gone" true: a worker process is TERM→KILLed and
+        # reaped here, so neither a crash NOR a hang eviction can leave
+        # an orphan process behind (in-process replicas no-op)
+        if slot.handle is not None:
+            try:
+                slot.handle.abort()
+            except Exception:        # the contract says "never raises";
+                pass                 # a broken handle must not block evict
         slot.handle = None
         slot.watch = None
         if slot.crash_loop.record_failure():
@@ -273,10 +414,9 @@ class Router:
     def _load(slot):
         """Load score from the same numbers the engine's gauges export:
         queue depth first, then in-flight requests, pool headroom as the
-        tie-break (more free blocks = less loaded)."""
-        eng = slot.handle.engine
-        return (eng.scheduler.queue_depth, len(eng.scheduler.running),
-                -eng.pool.free_blocks)
+        tie-break (more free blocks = less loaded).  Worker replicas
+        report the gauges they last shipped over the transport."""
+        return slot.handle.load()
 
     # ------------------------------------------------------------ requests
     def submit(self, prompt_ids, max_new_tokens=20, session_id=None,
@@ -334,7 +474,7 @@ class Router:
         last_shed = None
         for slot in order:
             try:
-                ereq = slot.handle.engine.add_request(
+                ereq = slot.handle.add_request(
                     rr.prompt, max_new_tokens=rr.max_new_tokens,
                     on_token=self._tap_token(rr),
                     on_finish=self._tap_finish(rr),
@@ -349,6 +489,13 @@ class Router:
                     **rr.params)
             except ShedRequest as e:
                 last_shed = e
+                continue
+            except ReplicaGone as e:
+                # the replica died under the placement call (worker
+                # process gone / transport torn): same crash eviction a
+                # step() raise produces, then keep trying survivors
+                if slot.state == HEALTHY:
+                    self._evict(slot, "crash", error=e)
                 continue
             rr.slot = slot
             rr.engine_req = ereq
@@ -388,7 +535,7 @@ class Router:
                     self._reg.counter(
                         "router_failover_token_mismatch_total").inc()
                     self._settle(rr, "failed", "failover-mismatch")
-                    rr.slot.handle.engine.cancel(ereq)
+                    rr.slot.handle.cancel(ereq)
                 else:
                     self._reg.counter("router_failover_dedup_total").inc()
                 return
@@ -425,7 +572,7 @@ class Router:
                 self._settle(rr, "failed", "client_error")
                 if rr.engine_req is not None and rr.slot is not None \
                         and rr.slot.state == HEALTHY:
-                    rr.slot.handle.engine.cancel(rr.engine_req)
+                    rr.slot.handle.cancel(rr.engine_req)
 
     def _tap_finish(self, rr):
         def tap(ereq):
@@ -531,6 +678,43 @@ class Router:
             n += 1
         return n
 
+    def wait_ready(self, timeout=None):
+        """Block until every healthy replica reports ready (True), or
+        the shared `timeout` expires (False).  In-process replicas are
+        born ready; worker processes become ready after import + engine
+        build + AOT load — drivers that submit a whole trace up front
+        call this first so nothing sheds as ``replica_warming``."""
+        deadline = None if timeout is None else \
+            time.monotonic() + float(timeout)
+        ok = True
+        for slot in self._healthy():
+            left = None if deadline is None else \
+                max(0.0, deadline - time.monotonic())
+            try:
+                ok = bool(slot.handle.wait_ready(timeout=left)) and ok
+            except ReplicaGone as e:
+                # died while warming (startup crash): the same
+                # eviction + backoff-respawn path as any other death
+                self._evict(slot, "crash", error=e)
+                ok = False
+        return ok
+
+    def metrics_snapshot(self):
+        """{replica_name: serving_* metrics records} from every live
+        replica — the engine snapshot API fanned out over the handles
+        (an RPC for worker replicas, whose counters live in their own
+        process registries; in-process replicas share THIS process's
+        registry, so only merge these for process-per-replica tiers)."""
+        out = {}
+        for slot in self._slots:
+            if slot.handle is None:
+                continue
+            try:
+                out[slot.name] = slot.handle.metrics_snapshot()
+            except Exception:        # a dying replica: skip, step() will
+                continue             # see the exit code next iteration
+        return out
+
     # ----------------------------------------------------- drain / close
     def drain(self, ttl_s=None):
         """Graceful shutdown: stop admitting (submit sheds with reason
@@ -545,7 +729,7 @@ class Router:
                            if r.state == "live"]:
                     if rr.engine_req is not None and rr.slot is not None \
                             and rr.slot.state == HEALTHY:
-                        rr.slot.handle.engine.cancel(rr.engine_req)
+                        rr.slot.handle.cancel(rr.engine_req)
                     self._settle(rr, "expired", "drained")
                 break
             self.step()
@@ -561,7 +745,7 @@ class Router:
         leaks = {}
         for slot in self._slots:
             if slot.handle is not None:
-                leaks[slot.name] = slot.handle.engine.close()
+                leaks[slot.name] = slot.handle.close()
                 slot.handle = None
             slot.state = DEAD
         if self._own_hb_dir:

@@ -57,8 +57,20 @@ evicted within the configured timeout — with every surviving request's
 final token stream byte-identical to the uninterrupted sequential
 reference and zero leaked blocks on the survivors.
 
+``--router --proc`` runs the **process-per-replica survival drill**: the
+same router state machine, but each replica is a REAL worker process
+(`paddle_tpu/serving/worker.py`) behind the framed socket transport.
+A worker is ``kill -9``'d mid-stream three times (failover re-prefill on
+the survivor, backoff respawns AOT-warm-started from exported serving
+artifacts, then crash-loop abandon — every death attributed by waitpid
+signal), an injected ``serving.transport_drop`` tears a frame in transit
+(must be rejected structurally and evicted, never a silent token gap),
+and after ``close()`` every spawned worker pid must be dead AND reaped —
+zero orphans, with all surviving streams byte-identical to the
+sequential reference and zero leaked blocks on survivors.
+
 Usage:  python tools/chaos_check.py [-v] [--mesh-change] [--cold-start]
-        [--serving] [--router]
+        [--serving] [--router [--proc]]
 Exit 0 = all recovery paths green.
 """
 import argparse
@@ -1036,6 +1048,317 @@ def run_router(out=None, verbose=False):
     return 0
 
 
+# ====================================================== --router --proc
+PROC_BUDGET_S = 480.0   # wall-clock guard: the drill must leave the
+                        # rest of tier-1 room inside the 870 s timeout
+
+
+def run_router_proc(out=None, verbose=False):
+    """The process-per-replica survival drill — the --router drill with
+    REAL processes and REAL ``kill -9``:
+
+    1. **SIGKILL x3 + failover + crash-loop**: two worker processes
+       (AOT-warm-started through the PR-8 artifact path when this jax
+       can serialize executables) serve 6 streams; worker r0 is
+       ``kill -9``'d mid-stream, respawned through the backoff policy,
+       killed twice more → the third death trips the crash-loop
+       detector (ABANDONED).  Every surviving stream must be
+       byte-identical to the sequential `generate()` reference (the
+       overlap dedup proving the resumed streams were consistency-
+       checked), each death must land in
+       ``router_worker_exits_total{signal=SIGKILL}``, and the
+       survivor's pool must come back leak-free over the wire.
+    2. **transport damage**: ``serving.transport_drop`` tears a frame
+       on r0's channel mid-stream — the transport must reject the
+       stream structurally (FrameError, counted), the router must
+       evict r0 as a crash and fail its streams over, and every stream
+       must STILL match the reference (a dropped frame may never
+       become a silent token gap).
+
+    After each phase, close() must leave **zero orphaned worker
+    processes** — every spawned pid dead AND reaped.
+    """
+    out = out if out is not None else sys.stdout
+    import shutil
+    import signal as _signal
+    import tempfile
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.resilience.backoff import Backoff
+    from paddle_tpu.serving import (LLMEngine, Router,
+                                    export_serving_artifacts)
+    from paddle_tpu.serving import worker as sw
+    from paddle_tpu.serving.transport import TransportPolicy
+    from paddle_tpu.text import GPTConfig, GPTForCausalLM
+    from paddle_tpu.text.generation import generate
+
+    def log(msg):
+        if verbose:
+            print(msg, file=out)
+
+    t_start = time.monotonic()
+    failures = []
+    reg = metrics.registry()
+
+    def counter(name, **labels):
+        return reg.counter(name, **labels).value
+
+    cfg_kw = dict(vocab_size=64, hidden_size=32, num_layers=2,
+                  num_heads=4, max_position_embeddings=64,
+                  hidden_dropout=0.0, attention_dropout=0.0,
+                  tensor_parallel=False)
+    paddle.seed(0)
+    model = GPTForCausalLM(GPTConfig(**cfg_kw))
+    rs = np.random.RandomState(11)
+    prompts = [rs.randint(0, 64, size=n).tolist()
+               for n in (9, 5, 12, 7, 4, 10)]
+    new_tokens = 16
+    refs = [generate(model, paddle.to_tensor(np.asarray([p], "int64")),
+                     max_new_tokens=new_tokens)
+            .numpy()[0, len(p):].tolist() for p in prompts]
+
+    eng_kw = dict(num_blocks=24, block_size=4, max_running=8,
+                  prefill_chunk=16)
+    aot_dir = tempfile.mkdtemp(prefix="chaos_proc_aot_")
+    aot_ok = False
+    pids = []
+    try:
+        # AOT artifacts exported ONCE so every worker — and every
+        # backoff respawn — warm-starts through the PR-8 path
+        exp_eng = LLMEngine(model, **eng_kw)
+        try:
+            export_serving_artifacts(exp_eng, aot_dir,
+                                     prompt_lens=[len(p)
+                                                  for p in prompts])
+            aot_ok = True
+        except Exception as e:
+            log(f"AOT export unavailable ({e}); workers compile live")
+        exp_eng.close()
+
+        # workers re-derive the same weights: seed 0 + the same config,
+        # step_delay throttles them so streams stay open long enough
+        # for a deterministic mid-stream kill
+        spec = sw.gpt_spec(config=cfg_kw, seed=0, engine=eng_kw,
+                           load_aot=aot_dir if aot_ok else None,
+                           step_delay_s=0.01)
+        pol = TransportPolicy(timeout=60.0, retries=1,
+                              backoff_base=0.05)
+
+        def replica_factory(name, hb_path, respawning=False):
+            h = sw.ProcReplica(spec, name, hb_path, policy=pol)
+            pids.append(h.proc.pid)
+            return h
+
+        def assert_no_orphans(tag):
+            for pid in pids:
+                try:
+                    os.kill(pid, 0)
+                except ProcessLookupError:
+                    continue         # dead AND reaped (zombies answer 0)
+                failures.append(f"{tag}: worker pid {pid} survived "
+                                f"close() — orphan process")
+
+        base = {n: counter(n) for n in (
+            "router_failover_requests_total",
+            "router_failover_dedup_total",
+            "router_failover_token_mismatch_total",
+            "router_respawns_total", "router_crash_loop_aborts_total",
+            "router_transport_frame_errors_total")}
+        base_crash = counter("router_replica_evicted_total",
+                             cause="crash")
+        base_kill9 = counter("router_worker_exits_total",
+                             signal="SIGKILL")
+
+        # ---- phase 1: kill -9 x3 → failover, respawn, abandon --------
+        router = Router(None, replicas=2, heartbeat_timeout=8.0,
+                        spawn_grace_s=120.0, respawn=True,
+                        backoff=Backoff(base=0.05, factor=2.0,
+                                        max_delay=0.2),
+                        crash_loop_threshold=3, crash_loop_window=600.0,
+                        replica_factory=replica_factory)
+        if not router.wait_ready(timeout=240.0):
+            failures.append("phase 1: workers never became ready")
+        reqs = [router.submit(p, max_new_tokens=new_tokens)
+                for p in prompts]
+        killed = set()       # pids SIGKILL'd: one kill per worker
+        deadline = time.monotonic() + 300.0
+        while time.monotonic() < deadline:
+            router.step()
+            slot0 = router._slots[0]
+            if len(killed) < 3 and slot0.state == "healthy" \
+                    and getattr(slot0.handle, "ready", False) \
+                    and slot0.handle.proc.pid not in killed:
+                live0 = [rr for rr in router._requests
+                         if rr.state == "live" and rr.slot is slot0]
+                mid_stream = any(len(rr.emitted) >= 2 for rr in live0)
+                # the FIRST kill must land mid-stream (that is the
+                # drill); later kills take the respawned replica
+                # whenever it is back up, streams or not — like real
+                # hardware (pid-gated: SIGKILL delivery is async, the
+                # same dying worker must not soak up all three)
+                if mid_stream or killed:
+                    os.kill(slot0.handle.proc.pid, _signal.SIGKILL)
+                    killed.add(slot0.handle.proc.pid)
+            if not router.has_work and len(killed) >= 3 \
+                    and slot0.state in ("abandoned", "dead"):
+                break
+        kills = len(killed)
+        for i, (rr, ref) in enumerate(zip(reqs, refs)):
+            if rr.state != "finished":
+                failures.append(f"kill: request {i} ended "
+                                f"{rr.state}/{rr.finish_reason!r}")
+            elif rr.emitted != ref:
+                failures.append(
+                    f"kill: request {i} stream diverged after "
+                    f"{rr.failovers} failover(s): {rr.emitted} vs "
+                    f"sequential {ref}")
+        n_failover = counter("router_failover_requests_total") \
+            - base["router_failover_requests_total"]
+        n_dedup = counter("router_failover_dedup_total") \
+            - base["router_failover_dedup_total"]
+        n_mismatch = counter("router_failover_token_mismatch_total") \
+            - base["router_failover_token_mismatch_total"]
+        n_crash = counter("router_replica_evicted_total",
+                          cause="crash") - base_crash
+        n_respawn = counter("router_respawns_total") \
+            - base["router_respawns_total"]
+        n_abort = counter("router_crash_loop_aborts_total") \
+            - base["router_crash_loop_aborts_total"]
+        n_kill9 = counter("router_worker_exits_total",
+                          signal="SIGKILL") - base_kill9
+        if kills != 3:
+            failures.append(f"kill: only delivered {kills}/3 SIGKILLs "
+                            f"before the deadline")
+        if n_failover < 1:
+            failures.append("kill: no request ever failed over — the "
+                            "kill missed every in-flight stream")
+        if n_dedup < 1:
+            failures.append(
+                "kill: failover dedup never fired — no stream was "
+                "killed MID-token (resume started before any emission)")
+        if n_mismatch:
+            failures.append(f"kill: {n_mismatch} failover overlap "
+                            f"token(s) MISMATCHED the emitted stream")
+        if n_crash != 3 or n_respawn != 2 or n_abort != 1:
+            failures.append(
+                f"kill: evictions/respawns/aborts = {n_crash}/"
+                f"{n_respawn}/{n_abort}, want 3/2/1")
+        if n_kill9 != 3:
+            failures.append(
+                f"kill: router_worker_exits_total{{signal=SIGKILL}} "
+                f"+{n_kill9}, want +3 (every death must be attributed "
+                f"to its waitpid signal)")
+        if router._slots[0].state != "abandoned":
+            failures.append(f"kill: r0 state "
+                            f"{router._slots[0].state!r} after 3 "
+                            f"SIGKILLs, want 'abandoned'")
+        survivor = router._slots[1].handle
+        if aot_ok and survivor is not None:
+            n_aot = (survivor.ready_info or {}).get("aot_loaded", 0)
+            if n_aot < 1:
+                failures.append(
+                    f"kill: survivor loaded {n_aot} AOT programs — "
+                    f"workers must warm-start through the artifact "
+                    f"path")
+        if survivor is not None:
+            snap = {r["name"] for r in survivor.metrics_snapshot()}
+            if "serving_tokens_generated_total" not in snap:
+                failures.append("kill: worker metrics_snapshot RPC "
+                                "returned no serving counters")
+        leaks = router.close()
+        for name, (leaked, bad) in leaks.items():
+            # strict ==[]: ProcReplica.close() reports (None, None) when
+            # the worker could not answer — UNKNOWN is not known-clean
+            if leaked != [] or bad != []:
+                failures.append(f"kill survivor {name} leak report "
+                                f"{leaked!r}/{bad!r}, want []/[] "
+                                f"(None = worker never reported)")
+        assert_no_orphans("kill")
+        log(f"phase 1 (kill -9 x3): {n_failover} failover(s), "
+            f"{n_dedup} dedup(s), {n_crash}/{n_respawn}/{n_abort} "
+            f"evict/respawn/abandon, {n_kill9} SIGKILL exits; streams "
+            f"identical; no orphans")
+
+        # ---- phase 2: frame dropped in transit → evict + failover ----
+        # frame ordinal on r0's parent-side channel: past ready + the
+        # add_request replies, into the token/step stream
+        with chaos.scoped("serving.transport_drop@12#r0"):
+            router2 = Router(None, replicas=2, heartbeat_timeout=8.0,
+                             spawn_grace_s=120.0, respawn=False,
+                             replica_factory=replica_factory)
+            if not router2.wait_ready(timeout=240.0):
+                failures.append("drop: workers never became ready")
+            reqs2 = [router2.submit(p, max_new_tokens=new_tokens)
+                     for p in prompts]
+            deadline = time.monotonic() + 240.0
+            while router2.has_work and time.monotonic() < deadline:
+                router2.step()
+        n_fe = counter("router_transport_frame_errors_total") \
+            - base["router_transport_frame_errors_total"]
+        drops = [e for e in router2.events
+                 if e["event"] == "evict" and e["cause"] == "crash"
+                 and "transport_drop" in str(e.get("error"))]
+        if n_fe < 1 or not drops:
+            failures.append(
+                f"drop: frame_errors +{n_fe}, transport-drop "
+                f"evictions {len(drops)} — the torn frame must be "
+                f"rejected structurally and evict the replica")
+        for i, (rr, ref) in enumerate(zip(reqs2, refs)):
+            if rr.state != "finished" or rr.emitted != ref:
+                failures.append(
+                    f"drop: request {i} {rr.state}/"
+                    f"{rr.finish_reason!r} stream "
+                    f"{'ok' if rr.emitted == ref else 'DIVERGED'} — a "
+                    f"dropped frame may never become a token gap")
+        leaks2 = router2.close()
+        for name, (leaked, bad) in leaks2.items():
+            if leaked != [] or bad != []:
+                failures.append(f"drop survivor {name} leak report "
+                                f"{leaked!r}/{bad!r}, want []/[] "
+                                f"(None = worker never reported)")
+        assert_no_orphans("drop")
+        log(f"phase 2 (transport_drop): {n_fe} frame error(s), "
+            f"{len(drops)} eviction(s); streams identical; no orphans")
+    finally:
+        chaos.uninstall()
+        # defensive sweep: the asserts above already proved no orphans
+        # on the green path; a FAILED drill must not leak processes
+        # into the test session either
+        for pid in pids:
+            try:
+                os.kill(pid, _signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        shutil.rmtree(aot_dir, ignore_errors=True)
+
+    elapsed = time.monotonic() - t_start
+    if elapsed > PROC_BUDGET_S:
+        failures.append(
+            f"time budget: drill took {elapsed:.0f}s > "
+            f"{PROC_BUDGET_S:.0f}s — it would crowd out the rest of "
+            f"tier-1 (spawns too slow / a wait wedged)")
+
+    if failures:
+        print("chaos_check --router --proc FAILED:", file=out)
+        for f in failures:
+            print(f"  - {f}", file=out)
+        return 1
+    print(f"chaos_check --router --proc OK ({elapsed:.0f}s): worker "
+          f"process kill -9'd 3x ({n_kill9} SIGKILL exits) -> "
+          f"{n_failover} failover(s) with overlap dedup, 2 backoff "
+          f"respawns + crash-loop abandon; injected transport frame "
+          f"drop rejected structurally and evicted; every surviving "
+          f"stream byte-identical to the sequential reference, zero "
+          f"leaked blocks on survivors, zero orphaned workers",
+          file=out)
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("-v", "--verbose", action="store_true")
@@ -1063,6 +1386,13 @@ def main(argv=None):
                          "surviving streams must be byte-identical to "
                          "the sequential reference) instead of the "
                          "4-family plan")
+    ap.add_argument("--proc", action="store_true",
+                    help="with --router: run the PROCESS-per-replica "
+                         "drill instead — real worker processes, real "
+                         "kill -9 mid-stream (3x -> failover + backoff "
+                         "respawn + crash-loop abandon), injected "
+                         "transport frame drop, zero orphaned workers "
+                         "after close()")
     ap.add_argument("--cold-start-worker", action="store_true",
                     help=argparse.SUPPRESS)   # the drill's restarted proc
     ap.add_argument("--cache-dir", help=argparse.SUPPRESS)
@@ -1070,6 +1400,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.cold_start_worker:
         return run_cold_worker(args.cache_dir, args.ckpt_root)
+    if args.router and args.proc:
+        return run_router_proc(verbose=args.verbose)
     if args.router:
         return run_router(verbose=args.verbose)
     if args.serving:

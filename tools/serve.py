@@ -16,6 +16,11 @@ or ``--random N`` synthetic prompts.
     # admission, heartbeat health, failover re-prefill, load shedding)
     python tools/serve.py --random 12 --replicas 2
 
+    # the same tier with REAL fault isolation: one worker PROCESS per
+    # replica over the framed socket transport — a segfault/OOM in one
+    # replica is an exit code, not a tier outage
+    python tools/serve.py --random 12 --replicas 2 --proc
+
 ``--export-aot DIR`` writes the replica's per-bucket AOT artifacts
 (serving.aot) after the run, so the next replica starts zero-compile;
 in router mode ``--load-aot`` warm-starts every replica AND every
@@ -27,7 +32,11 @@ admission-control story from docs/serving.md.
 CheckpointManager preemption-flush pattern — the handler only records
 the signal; the drive loop then stops admitting, drains in-flight
 requests (finish, or expire past ``--drain-ttl``), flushes a final
-metrics snapshot to stderr, and frees the pool(s).
+metrics snapshot to stderr, and frees the pool(s).  In ``--proc`` mode
+the worker serving counters are pulled over the ``metrics_snapshot``
+RPC and merged, then termination is forwarded to every worker process
+group and reaped (TERM→KILL) before the snapshot prints — a kill that
+lands while a worker is still compiling leaves no orphans.
 """
 import argparse
 import json
@@ -58,8 +67,16 @@ def build_parser():
     ap.add_argument("--prefill-chunk", type=int, default=64)
     ap.add_argument("--replicas", type=int, default=1, metavar="N",
                     help="N>1 serves through the multi-replica Router "
-                         "(in-process replicas; a production tier runs "
-                         "one serve.py per replica)")
+                         "(in-process replicas unless --proc)")
+    ap.add_argument("--proc", action="store_true",
+                    help="router mode with PROCESS-per-replica "
+                         "workers: each replica is a spawned "
+                         "`paddle_tpu.serving.worker` process behind "
+                         "the framed socket transport — a crash/OOM "
+                         "in one replica cannot take the tier down")
+    ap.add_argument("--spawn-grace", type=float, default=120.0,
+                    help="--proc: heartbeat grace (s) before a fresh "
+                         "worker's FIRST beat (covers import+compile)")
     ap.add_argument("--heartbeat-timeout", type=float, default=5.0,
                     help="router: stale-beat seconds before a replica "
                          "is evicted as hung")
@@ -109,25 +126,22 @@ def main(argv=None):
     from paddle_tpu.text import GPTConfig, GPTForCausalLM
 
     pt.seed(0)
+    tiny_kw = dict(vocab_size=256, hidden_size=64, num_layers=2,
+                   num_heads=4, max_position_embeddings=256,
+                   hidden_dropout=0.0, attention_dropout=0.0,
+                   tensor_parallel=False)
+    preset_kw = dict(hidden_dropout=0.0, attention_dropout=0.0,
+                     tensor_parallel=False)
     if args.preset:
-        cfg = GPTConfig.from_preset(args.preset, hidden_dropout=0.0,
-                                    attention_dropout=0.0,
-                                    tensor_parallel=False)
+        cfg = GPTConfig.from_preset(args.preset, **preset_kw)
     else:
-        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
-                        num_heads=4, max_position_embeddings=256,
-                        hidden_dropout=0.0, attention_dropout=0.0,
-                        tensor_parallel=False)
-    with pt.LazyGuard():
-        model = GPTForCausalLM(cfg)
-
-    def engine_factory():
-        return serving.LLMEngine(
-            model, num_blocks=args.num_blocks,
-            block_size=args.block_size, max_running=args.max_running,
-            prefill_chunk=args.prefill_chunk,
-            shed_queue_depth=args.shed_queue_depth,
-            shed_free_blocks=args.shed_free_blocks)
+        cfg = GPTConfig(**tiny_kw)
+    engine_kw = dict(num_blocks=args.num_blocks,
+                     block_size=args.block_size,
+                     max_running=args.max_running,
+                     prefill_chunk=args.prefill_chunk,
+                     shed_queue_depth=args.shed_queue_depth,
+                     shed_free_blocks=args.shed_free_blocks)
 
     warm_start = None
     if args.load_aot:
@@ -137,15 +151,46 @@ def main(argv=None):
                   file=sys.stderr)
 
     router = None
-    if args.replicas > 1:
+    if args.proc:
+        # process-per-replica tier: no model in THIS process — each
+        # worker re-derives it from the spec (seed 0 + the config) and
+        # warm-starts itself from --load-aot; respawns do the same
+        from paddle_tpu.serving import worker as sw
+        spec = sw.gpt_spec(preset=args.preset or None,
+                           overrides=preset_kw if args.preset else None,
+                           config=None if args.preset else tiny_kw,
+                           seed=0, engine=engine_kw,
+                           load_aot=args.load_aot, lazy=True)
+
+        def replica_factory(name, hb_path, respawning=False):
+            return sw.ProcReplica(spec, name, hb_path)
+
         backend = router = serving.Router(
-            engine_factory, replicas=args.replicas,
+            None, replicas=args.replicas,
             heartbeat_timeout=args.heartbeat_timeout,
-            warm_start=warm_start)
+            spawn_grace_s=args.spawn_grace,
+            replica_factory=replica_factory)
+        # wait for the workers (import+build+AOT) in interruptible
+        # slices: a SIGTERM during worker compile must fall through to
+        # the drain/close path below, which reaps the whole tier
+        while stop["sig"] is None and not router.wait_ready(timeout=0.5):
+            pass
     else:
-        backend = engine_factory()
-        if warm_start is not None:
-            warm_start(backend)
+        with pt.LazyGuard():
+            model = GPTForCausalLM(cfg)
+
+        def engine_factory():
+            return serving.LLMEngine(model, **engine_kw)
+
+        if args.replicas > 1:
+            backend = router = serving.Router(
+                engine_factory, replicas=args.replicas,
+                heartbeat_timeout=args.heartbeat_timeout,
+                warm_start=warm_start)
+        else:
+            backend = engine_factory()
+            if warm_start is not None:
+                warm_start(backend)
 
     if args.random:
         rs = np.random.RandomState(0)
@@ -211,11 +256,22 @@ def main(argv=None):
     finally:
         for s, h in prev.items():
             signal.signal(s, h)
-        # final metrics snapshot BEFORE freeing the pool(s)
+        # final metrics snapshot BEFORE freeing the pool(s): in --proc
+        # mode the serving_* counters live in the WORKER processes, so
+        # pull them over the metrics_snapshot RPC while the workers are
+        # still alive and merge; close() then forwards termination to
+        # every worker process group and REAPS (TERM->KILL escalation,
+        # even mid-compile) before the snapshot is printed — no orphans
         reg = metrics.registry()
         snap = {m["name"]: m.get("value", m.get("count"))
                 for m in reg.snapshot()
                 if m["name"].startswith(("serving_", "router_"))}
+        if args.proc and router is not None:
+            for _name, recs in router.metrics_snapshot().items():
+                for m in recs:
+                    key = m["name"]
+                    snap[key] = (snap.get(key) or 0) + \
+                        (m.get("value", m.get("count")) or 0)
         leaks = backend.close()
         print(json.dumps({
             "requests": len(prompts), "shed": shed,
